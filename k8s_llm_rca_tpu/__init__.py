@@ -1,0 +1,25 @@
+"""k8s_llm_rca_tpu — a TPU-native LLM-agent framework for Kubernetes root-cause analysis.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+``freiris/k8s-llm-rca`` (see SURVEY.md): a three-stage LLM agent pipeline
+(metapath planning -> Cypher compilation -> temporal state audit) that in the
+reference ran against the remote OpenAI Assistants API and two external Neo4j
+servers.  Here the whole loop runs locally:
+
+- ``models/ ops/ parallel/`` — JAX/Pallas Llama / Mixtral / e5 model stacks with
+  DP/TP/PP/SP/EP shardings over a ``jax.sharding.Mesh`` (ICI/DCN collectives).
+- ``engine/`` — sharded prefill + autoregressive decode with slot-based and
+  paged KV caches, on-device sampling, stop sequences and forced fenced output.
+- ``serve/`` — an assistants-compatible local API (Assistant/Thread/Message/Run
+  with the reference's run-state machine and token-usage windows; reference:
+  common/openai_generic_assistant.py) on a continuous-batching scheduler.
+- ``graph/`` — a graph query layer: in-memory property-graph store with a
+  mini-Cypher executor (hermetic), plus an optional Neo4j bolt client
+  (reference: common/neo4j_query_executor.py).
+- ``rca/`` — the three agent stages, behavior-equivalent to the reference's
+  find_metapath/, generate_query/ and check_state/ packages.
+- ``sweeps/`` — interactive and metered batch drivers (reference: test_all.py,
+  test_with_file.py).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,226 @@
+"""Configuration layer.
+
+The reference has no config system at all — Neo4j URIs, model names, polling
+constants, retry counts and file paths are hardcoded in every driver
+(reference: test_all.py:21-22, find_metapath/find_srckind_metapath_neo4j.py:50,
+common/openai_generic_assistant.py:94-95).  Here every knob is an explicit
+frozen dataclass so drivers, tests and benches share one source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Decoder-LM architecture config (Llama family; Mixtral via n_experts>0)."""
+
+    name: str = "tiny"
+    vocab_size: int = 512
+    hidden_size: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 32
+    intermediate_size: int = 256
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 1024
+    dtype: str = "float32"          # compute/weight dtype ("bfloat16" on TPU)
+    tie_embeddings: bool = True
+    # MoE (0 experts == dense Llama MLP)
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Named architecture presets.  TINY/_MOE are for hermetic CPU tests; the
+# 1B/8B/8x7B presets mirror the public architectures of the target models in
+# BASELINE.md (TinyLlama-1.1B-Chat, Llama-3-8B-Instruct, Mixtral-8x7B).
+# ---------------------------------------------------------------------------
+
+TINY = ModelConfig(name="tiny")
+
+TINY_MOE = ModelConfig(name="tiny_moe", n_experts=4, n_experts_per_tok=2)
+
+TINYLLAMA_1B = ModelConfig(
+    name="tinyllama-1.1b",
+    vocab_size=32000,
+    hidden_size=2048,
+    n_layers=22,
+    n_heads=32,
+    n_kv_heads=4,
+    head_dim=64,
+    intermediate_size=5632,
+    rope_theta=10000.0,
+    max_seq_len=2048,
+    dtype="bfloat16",
+    tie_embeddings=False,
+)
+
+LLAMA3_8B = ModelConfig(
+    name="llama3-8b",
+    vocab_size=128256,
+    hidden_size=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    head_dim=128,
+    intermediate_size=14336,
+    rope_theta=500000.0,
+    max_seq_len=8192,
+    dtype="bfloat16",
+    tie_embeddings=False,
+)
+
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    head_dim=128,
+    intermediate_size=14336,
+    rope_theta=1000000.0,
+    max_seq_len=8192,
+    dtype="bfloat16",
+    tie_embeddings=False,
+    n_experts=8,
+    n_experts_per_tok=2,
+)
+
+MODEL_REGISTRY = {
+    c.name: c for c in (TINY, TINY_MOE, TINYLLAMA_1B, LLAMA3_8B, MIXTRAL_8X7B)
+}
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """Bidirectional encoder config (e5 family) for embedding/rerank."""
+
+    name: str = "tiny-encoder"
+    vocab_size: int = 512
+    hidden_size: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    intermediate_size: int = 256
+    max_seq_len: int = 512
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+
+
+TINY_ENCODER = EncoderConfig()
+
+E5_LARGE = EncoderConfig(
+    name="e5-large",
+    vocab_size=30522,
+    hidden_size=1024,
+    n_layers=24,
+    n_heads=16,
+    intermediate_size=4096,
+    max_seq_len=512,
+    dtype="bfloat16",
+)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device-mesh shape.  Axis names are load-bearing throughout:
+
+    - ``data``   — DP: batch sharding (and FSDP-style weight sharding later)
+    - ``model``  — TP: attention heads / MLP hidden dim over ICI
+    - ``expert`` — EP: MoE experts (all-to-all token dispatch)
+    - ``seq``    — SP/CP: sequence sharding (ring attention / Ulysses)
+    - ``stage``  — PP: pipeline stages over DCN
+    """
+
+    data: int = 1
+    model: int = 1
+    expert: int = 1
+    seq: int = 1
+    stage: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("data", "model", "expert", "seq", "stage")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.model, self.expert, self.seq, self.stage)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Inference-engine config: batching, KV cache, sampling, limits."""
+
+    max_batch: int = 8                 # decode slots (continuous batching width)
+    max_seq_len: int = 1024            # per-slot KV capacity
+    prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024)
+    max_new_tokens: int = 256
+    # paged KV cache
+    paged: bool = False
+    page_size: int = 16
+    num_pages: int = 1024
+    # sampling defaults
+    temperature: float = 0.0           # 0 == greedy
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    # decode loop
+    decode_chunk: int = 16             # device steps per host sync in scan mode
+
+
+@dataclass(frozen=True)
+class RCAConfig:
+    """Agent-pipeline config (retry budgets mirror the reference's:
+    test_all.py:63,99; polling limits common/openai_generic_assistant.py:94-95)."""
+
+    locator_max_attempts: int = 3
+    cypher_max_attempts: int = 3
+    metapath_max_hops: int = 3
+    srckind_limit: int = 5
+    state_limit: int = 10
+    run_timeout_s: float = 600.0
+    model: str = "tiny"                # serve-side model name
+
+
+@dataclass(frozen=True)
+class SweepConfig:
+    """Batch-driver config (reference: test_with_file.py:42-43,177-198)."""
+
+    input_csv: str = "data/incidents.csv"
+    output_json: str = "output/rca-results.json"
+    locator_usage_limit: int = 10
+    cypher_usage_limit: int = 20
+    analyzer_usage_limit: int = 30
+
+
+@dataclass(frozen=True)
+class FrameworkConfig:
+    model: ModelConfig = field(default_factory=lambda: TINY)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    rca: RCAConfig = field(default_factory=RCAConfig)
+    sweep: SweepConfig = field(default_factory=SweepConfig)

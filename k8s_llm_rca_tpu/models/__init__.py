@@ -1,0 +1,8 @@
+from k8s_llm_rca_tpu.models.llama import (  # noqa: F401
+    KVCache,
+    init_params,
+    init_cache,
+    forward,
+    prefill,
+    decode_step,
+)

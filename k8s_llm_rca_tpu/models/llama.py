@@ -1,0 +1,278 @@
+"""Llama-family decoder LM, TPU-first.
+
+Pure-functional JAX: params are a plain pytree (dict/list of arrays), the
+config is static, and the three entry points — ``forward`` (training/scoring),
+``prefill`` (fill a KV-cache slot), ``decode_step`` (one autoregressive step
+for all slots) — are designed to be jitted once with static shapes and reused
+for the whole serving lifetime.  ``n_experts > 0`` switches the MLP to a
+Mixtral-style sparse-MoE block (models/mixtral.py re-exports the presets; the
+expert-parallel all-to-all dispatch path lives in parallel/moe.py).
+
+This stack replaces the reference's remote GPT-4 compute (the reference's
+only "model code" is the HTTPS client at common/openai_generic_assistant.py);
+architecture follows the public Llama/Mixtral papers, not the reference.
+
+Sharding: weights carry NamedShardings from runtime/sharding.llama_param_specs
+(TP over "model", EP over "expert"); under jit XLA inserts the all-gathers /
+psums.  Batch dims shard over "data".
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_rca_tpu.config import ModelConfig
+from k8s_llm_rca_tpu.ops.attention import causal_attention, decode_attention
+from k8s_llm_rca_tpu.ops.norms import rms_norm
+from k8s_llm_rca_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Slot-based contiguous KV cache: k/v are [L, B, S_max, n_kv, d]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def n_slots(self) -> int:
+        return self.k.shape[1]
+
+
+def _dense(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random init (scaled normal).  Real checkpoints load via models/loader."""
+    dtype = jnp.dtype(cfg.dtype)
+    h, q, kv, inter = cfg.hidden_size, cfg.q_dim, cfg.kv_dim, cfg.intermediate_size
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    scale = 1.0 / math.sqrt(h)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[i], 8)
+        layer: Dict[str, Any] = {
+            "attn_norm": jnp.ones((h,), dtype),
+            "mlp_norm": jnp.ones((h,), dtype),
+            "wq": _dense(lk[0], (h, q), scale, dtype),
+            "wk": _dense(lk[1], (h, kv), scale, dtype),
+            "wv": _dense(lk[2], (h, kv), scale, dtype),
+            "wo": _dense(lk[3], (q, h), scale / math.sqrt(2 * cfg.n_layers), dtype),
+        }
+        if cfg.n_experts > 0:
+            e = cfg.n_experts
+            layer.update(
+                {
+                    "router": _dense(lk[4], (h, e), scale, dtype),
+                    "w_gate": _dense(lk[5], (e, h, inter), scale, dtype),
+                    "w_up": _dense(lk[6], (e, h, inter), scale, dtype),
+                    "w_down": _dense(
+                        lk[7], (e, inter, h), scale / math.sqrt(2 * cfg.n_layers), dtype
+                    ),
+                }
+            )
+        else:
+            layer.update(
+                {
+                    "w_gate": _dense(lk[5], (h, inter), scale, dtype),
+                    "w_up": _dense(lk[6], (h, inter), scale, dtype),
+                    "w_down": _dense(
+                        lk[7], (inter, h), scale / math.sqrt(2 * cfg.n_layers), dtype
+                    ),
+                }
+            )
+        layers.append(layer)
+
+    params: Params = {
+        "embedding": _dense(keys[-2], (cfg.vocab_size, h), 1.0, dtype),
+        "final_norm": jnp.ones((h,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense(keys[-1], (cfg.vocab_size, h), scale, dtype)
+    return params
+
+
+def init_cache(cfg: ModelConfig, n_slots: int, max_seq_len: Optional[int] = None) -> KVCache:
+    s = max_seq_len or cfg.max_seq_len
+    if s > cfg.max_seq_len:
+        # positions past the RoPE table would silently clamp to its last row
+        # (JAX out-of-bounds gather semantics) and corrupt rotations.
+        raise ValueError(
+            f"cache max_seq_len {s} exceeds model max_seq_len {cfg.max_seq_len}")
+    shape = (cfg.n_layers, n_slots, s, cfg.n_kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# blocks
+# ---------------------------------------------------------------------------
+
+
+def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
+         angles: jnp.ndarray, positions: jnp.ndarray):
+    """x [B, S, H] -> q [B, S, n_heads, d], k/v [B, S, n_kv, d] (roped q,k)."""
+    b, s, _ = x.shape
+    q = (x @ layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (x @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (x @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, angles, positions)
+    k = apply_rope(k, angles, positions)
+    return q, k, v
+
+
+def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.n_experts > 0:
+        return _moe_mlp(cfg, layer, x)
+    gate = jax.nn.silu(x @ layer["w_gate"])
+    up = x @ layer["w_up"]
+    return (gate * up) @ layer["w_down"]
+
+
+def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Mixtral sparse-MoE MLP, dense "soft-dispatch" formulation.
+
+    Every expert runs on every token and the top-k router weights zero out the
+    rest — XLA-friendly (static shapes, one big einsum per projection, experts
+    batched on the MXU) and exactly equal to hard routing.  The bandwidth-
+    optimal EP dispatch (all_to_all over the "expert" axis) lives in
+    parallel/moe.py and is used by the sharded engine path.
+    """
+    b, s, h = x.shape
+    e, k = cfg.n_experts, cfg.n_experts_per_tok
+    router_logits = (x @ layer["router"]).astype(jnp.float32)      # [B,S,E]
+    topv, topi = jax.lax.top_k(router_logits, k)                   # [B,S,k]
+    weights = jax.nn.softmax(topv, axis=-1)                        # [B,S,k]
+    # scatter the top-k weights back to a dense [B,S,E] map
+    onehot = jax.nn.one_hot(topi, e, dtype=jnp.float32)            # [B,S,k,E]
+    dense_w = jnp.einsum("bske,bsk->bse", onehot, weights)         # [B,S,E]
+
+    gate = jax.nn.silu(jnp.einsum("bsh,ehi->bsei", x, layer["w_gate"]))
+    up = jnp.einsum("bsh,ehi->bsei", x, layer["w_up"])
+    per_expert = jnp.einsum("bsei,eih->bseh", gate * up, layer["w_down"])
+    return jnp.einsum("bseh,bse->bsh", per_expert,
+                      dense_w.astype(x.dtype))
+
+
+def _block_prefill(cfg, layer, x, angles, positions, seq_lens):
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(cfg, layer, h, angles, positions)
+    attn = causal_attention(q, k, v, seq_lens)
+    b, s, _, _ = attn.shape
+    x = x + attn.reshape(b, s, cfg.q_dim) @ layer["wo"]
+    h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    x = x + _mlp(cfg, layer, h)
+    return x, k, v
+
+
+def _logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("bsh,vh->bsv", x, head).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
+            seq_lens: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Training/scoring forward: tokens [B, S] -> logits [B, S, V] (fp32)."""
+    b, s = tokens.shape
+    if seq_lens is None:
+        seq_lens = jnp.full((b,), s, jnp.int32)
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
+    for layer in params["layers"]:
+        x, _, _ = _block_prefill(cfg, layer, x, angles, positions, seq_lens)
+    return _logits(cfg, params, x)
+
+
+def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
+            tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray
+            ) -> Tuple[KVCache, jnp.ndarray]:
+    """Prefill ONE sequence into cache slot ``slot``.
+
+    tokens [1, S_pad] right-padded; ``length`` scalar valid length; returns
+    (cache', last-token logits [1, V]).  One compile per padded bucket length
+    (engine/engine.py buckets prompt lengths to keep recompiles bounded).
+    """
+    _, s_pad = tokens.shape
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = jnp.arange(s_pad)[None, :]
+    seq_lens = jnp.asarray(length).reshape(1)
+    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
+
+    ks, vs = [], []
+    for layer in params["layers"]:
+        x, k, v = _block_prefill(cfg, layer, x, angles, positions, seq_lens)
+        ks.append(k[0])  # [S_pad, n_kv, d]
+        vs.append(v[0])
+    new_k = jnp.stack(ks)  # [L, S_pad, n_kv, d]
+    new_v = jnp.stack(vs)
+
+    # write [L, 1, S_pad, ...] into the slot row at sequence offset 0
+    k_cache = jax.lax.dynamic_update_slice(
+        cache.k, new_k[:, None], (0, slot, 0, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        cache.v, new_v[:, None], (0, slot, 0, 0, 0))
+
+    last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)  # [1,1,H]
+    logits = _logits(cfg, params, last)[:, 0]                       # [1, V]
+    return KVCache(k_cache, v_cache), logits
+
+
+def _write_token_kv(cache_layer: jnp.ndarray, kv_new: jnp.ndarray,
+                    lengths: jnp.ndarray) -> jnp.ndarray:
+    """Scatter one token's k/v per slot: cache [B, S, n_kv, d], kv_new
+    [B, n_kv, d], written at per-slot index lengths[b]."""
+    def write_one(c, kv, pos):
+        return jax.lax.dynamic_update_slice(c, kv[None], (pos, 0, 0))
+
+    return jax.vmap(write_one)(cache_layer, kv_new, lengths)
+
+
+def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
+                tokens: jnp.ndarray, lengths: jnp.ndarray
+                ) -> Tuple[KVCache, jnp.ndarray]:
+    """One decode step for ALL slots (continuous batching inner loop).
+
+    tokens [B] current token per slot; lengths [B] tokens already in the
+    cache (the new token is written at index lengths[b] and attends to
+    lengths[b]+1 positions).  Returns (cache', logits [B, V]).
+    """
+    b = tokens.shape[0]
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = lengths[:, None]                       # [B, 1]
+    x = params["embedding"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
+
+    new_ks, new_vs = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, layer, h, angles, positions)   # q [B,1,h,d]
+        k_cache = _write_token_kv(cache.k[li], k[:, 0], lengths)
+        v_cache = _write_token_kv(cache.v[li], v[:, 0], lengths)
+        new_ks.append(k_cache)
+        new_vs.append(v_cache)
+        attn = decode_attention(q, k_cache, v_cache, lengths + 1)
+        x = x + attn.reshape(b, 1, cfg.q_dim) @ layer["wo"]
+        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, layer, hm)
+
+    cache = KVCache(jnp.stack(new_ks), jnp.stack(new_vs))
+    logits = _logits(cfg, params, x)[:, 0]             # [B, V]
+    return cache, logits

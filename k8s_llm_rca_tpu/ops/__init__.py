@@ -1,0 +1,7 @@
+from k8s_llm_rca_tpu.ops.norms import rms_norm, layer_norm  # noqa: F401
+from k8s_llm_rca_tpu.ops.rope import rope_frequencies, apply_rope  # noqa: F401
+from k8s_llm_rca_tpu.ops.attention import (  # noqa: F401
+    causal_attention,
+    decode_attention,
+    repeat_kv,
+)

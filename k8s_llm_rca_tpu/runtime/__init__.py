@@ -1,0 +1,6 @@
+from k8s_llm_rca_tpu.runtime.mesh import (  # noqa: F401
+    build_mesh,
+    local_mesh,
+    initialize_distributed,
+    cpu_mesh_for_tests,
+)

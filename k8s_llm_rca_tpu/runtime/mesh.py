@@ -1,0 +1,104 @@
+"""Device mesh & distributed init — the framework's NCCL/MPI-equivalent layer.
+
+The reference's only "communication backend" is HTTPS to OpenAI plus two bolt
+sockets (common/openai_generic_assistant.py:14, common/neo4j_query_executor.py:8).
+Here the communication layer is XLA collectives over a ``jax.sharding.Mesh``:
+ICI within a slice, DCN across hosts.  Everything downstream (TP matmul
+partials, ring-attention ppermute, MoE all-to-all, PP stage transfer) rides the
+mesh built here; multi-host pods go through ``jax.distributed.initialize``.
+
+Axis convention (see config.MeshConfig): ``data`` (DP), ``model`` (TP),
+``expert`` (EP), ``seq`` (SP/CP), ``stage`` (PP).  Axes of size 1 are kept in
+the mesh so sharding specs are uniform across topologies: a spec written for a
+v5e-16 runs unchanged on a single chip.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from k8s_llm_rca_tpu.config import MeshConfig
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host init (one JAX process per host of a pod slice).
+
+    No-op for single-process runs so drivers can call it unconditionally.
+    """
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def build_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the 5-axis logical mesh over the given (default: all) devices.
+
+    Device order follows ``jax.devices()``, which JAX already orders so that
+    adjacent devices are ICI neighbors; the fastest-varying axes here are
+    ``seq``/``stage`` then ``model``, keeping TP/CP collectives on ICI and
+    leaving ``data`` (the slowest axis) to span DCN on multi-host pods.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != cfg.n_devices:
+        raise ValueError(
+            f"mesh {cfg.shape} needs {cfg.n_devices} devices, have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(cfg.shape)
+    return Mesh(arr, cfg.axis_names)
+
+
+def local_mesh(model: int = 1, data: int = 1, expert: int = 1, seq: int = 1,
+               stage: int = 1) -> Mesh:
+    """Convenience: build a mesh from axis sizes over local devices."""
+    return build_mesh(MeshConfig(data=data, model=model, expert=expert,
+                                 seq=seq, stage=stage))
+
+
+def single_device_mesh() -> Mesh:
+    """Mesh of one device — all axes size 1 (specs still resolve)."""
+    return build_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+def cpu_mesh_for_tests(n: int = 8, **axis_sizes) -> Mesh:
+    """Mesh over ``n`` virtual CPU devices for hermetic multi-chip tests.
+
+    Requires ``XLA_FLAGS=--xla_force_host_platform_device_count=<n>`` and the
+    cpu platform to be selected *before* the backend initializes (tests do
+    this in conftest.py).
+    """
+    devices = [d for d in jax.devices() if d.platform == "cpu"][:n]
+    if len(devices) < n:
+        raise RuntimeError(
+            f"need {n} cpu devices, have {len(devices)}; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} before importing jax"
+        )
+    if not axis_sizes:
+        axis_sizes = {"data": 2, "model": n // 2}
+    cfg = MeshConfig(**axis_sizes)
+    return build_mesh(cfg, devices=devices[: cfg.n_devices])
+
+
+def set_cpu_platform(n_devices: int = 8) -> None:
+    """Force the CPU platform with ``n_devices`` virtual devices.  Must run
+    before any JAX computation; used by test harnesses and the multi-chip
+    dry-run entry point."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,86 @@
+"""Sharding specs: how params/activations map onto the mesh.
+
+GSPMD-style tensor parallelism: we annotate weights and a few activation
+boundaries with ``NamedSharding``/``with_sharding_constraint`` and let XLA
+insert the collectives (all-gather on column-parallel inputs, psum on
+row-parallel outputs) — the idiomatic TPU replacement for hand-written NCCL.
+
+Layout (per transformer layer):
+- wq/wk/wv  [H, heads*d]  -> P(None, "model")   (column parallel: heads sharded)
+- wo        [heads*d, H]  -> P("model", None)   (row parallel: psum output)
+- w_gate/w_up [H, I]      -> P(None, "model")
+- w_down    [I, H]        -> P("model", None)
+- embedding [V, H]        -> P(None, "model")   (hidden sharded; lm_head tied)
+- MoE experts get a leading "expert" axis on the stacked expert weights.
+Batch dims of activations shard on "data"; sequence on "seq" for SP/CP.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_llm_rca_tpu.config import ModelConfig
+
+PyTree = Any
+
+
+def llama_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models/llama.init_params structure."""
+    layer = {
+        "attn_norm": P(None),
+        "mlp_norm": P(None),
+        "wq": P(None, "model"),
+        "wk": P(None, "model"),
+        "wv": P(None, "model"),
+        "wo": P("model", None),
+    }
+    if cfg.n_experts > 0:
+        layer.update(
+            {
+                "router": P(None, None),
+                # stacked experts: [E, H, I] / [E, I, H]; experts over the
+                # expert axis, hidden over model — EP x TP composes.
+                "w_gate": P("expert", None, "model"),
+                "w_up": P("expert", None, "model"),
+                "w_down": P("expert", "model", None),
+            }
+        )
+    else:
+        layer.update(
+            {
+                "w_gate": P(None, "model"),
+                "w_up": P(None, "model"),
+                "w_down": P("model", None),
+            }
+        )
+    specs: Dict[str, Any] = {
+        "embedding": P(None, "model"),
+        "final_norm": P(None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "model")  # [V, H], hidden sharded like embedding
+    return specs
+
+
+def kv_cache_specs() -> Any:
+    """KV cache [L, B, S, n_kv, d]: batch on data, kv-heads on model."""
+    return P(None, "data", None, "model", None)
+
+
+def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
+    """Device-put a pytree with NamedShardings built from a spec pytree."""
+    def _put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(_put, tree, specs, is_leaf=lambda x: x is None)
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint with an explicit mesh.  Invalid specs (wrong
+    rank, non-divisible axis) must fail loudly — never silently drop the
+    intended layout."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -1,0 +1,11 @@
+from k8s_llm_rca_tpu.utils.fenced import (  # noqa: F401
+    extract_json,
+    extract_cypher,
+    extract_fenced,
+    FencedBlockError,
+)
+from k8s_llm_rca_tpu.utils.tokenizer import (  # noqa: F401
+    ByteTokenizer,
+    Tokenizer,
+    get_tokenizer,
+)

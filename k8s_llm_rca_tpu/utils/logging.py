@@ -1,0 +1,71 @@
+"""Structured logging + lightweight timing/metrics.
+
+The reference's observability is bare ``print`` banners plus wall-clock
+bracketing (test_all.py:143-151, test_with_file.py:173-175).  This module
+keeps that per-phase timing but as structured, queryable records, and adds
+engine-side counters (tokens, steps, queue depth) that the sweep drivers and
+``bench.py`` report.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+def get_logger(name: str = "k8s_llm_rca_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+@dataclass
+class Metrics:
+    """Process-local counters + phase timers."""
+
+    counters: Dict[str, float] = field(default_factory=lambda: defaultdict(float))
+    timings: Dict[str, List[float]] = field(default_factory=lambda: defaultdict(list))
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        self.counters[name] += value
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timings[name].append(time.perf_counter() - t0)
+
+    def total(self, name: str) -> float:
+        return sum(self.timings.get(name, []))
+
+    def p50(self, name: str) -> float:
+        xs = sorted(self.timings.get(name, []))
+        if not xs:
+            return 0.0
+        n = len(xs)
+        mid = n // 2
+        return xs[mid] if n % 2 == 1 else 0.5 * (xs[mid - 1] + xs[mid])
+
+    def snapshot(self) -> Dict[str, float]:
+        out = dict(self.counters)
+        for k, v in self.timings.items():
+            out[f"{k}.total_s"] = sum(v)
+            out[f"{k}.count"] = float(len(v))
+            out[f"{k}.p50_s"] = self.p50(k)
+        return out
+
+
+METRICS = Metrics()

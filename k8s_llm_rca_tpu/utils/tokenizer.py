@@ -1,0 +1,94 @@
+"""In-tree tokenizers.
+
+The reference has no client-side tokenizer at all: token counts are read back
+from the OpenAI Runs API (common/openai_generic_assistant.py:117-135).  The
+local engine needs exact token accounting, so tokenization is in-tree:
+
+- ``ByteTokenizer`` — hermetic UTF-8 byte-level tokenizer (256 byte ids +
+  specials, vocab padded to a lane-friendly 512).  Default for tests, the
+  scripted oracle backend, and random-weight benches.
+- ``HFTokenizer`` — loads a real SentencePiece/BPE tokenizer from a *local*
+  path via ``transformers`` for real checkpoints (zero-egress environment:
+  never downloads).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    pad_id: int
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]: ...
+    def decode(self, ids: List[int]) -> str: ...
+    def count(self, text: str) -> int: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are raw bytes; specials follow."""
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= 259, "need 256 bytes + pad/bos/eos"
+        self.vocab_size = vocab_size
+        self.pad_id = 256
+        self.bos_id = 257
+        self.eos_id = 258
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def count(self, text: str) -> int:
+        return len(self.encode(text))
+
+
+class HFTokenizer:
+    """Wrap a locally available HuggingFace tokenizer (e.g. a mounted
+    TinyLlama/Llama-3 checkpoint dir).  Import is deferred so the hermetic
+    path never touches ``transformers``."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer  # local path only; no network
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        # `is None` checks, not `or`: id 0 is a legitimate token id (e.g.
+        # pad_token_id == 0 in BERT-family tokenizers like e5).
+        self.bos_id = 1 if self._tok.bos_token_id is None else self._tok.bos_token_id
+        self.eos_id = 2 if self._tok.eos_token_id is None else self._tok.eos_token_id
+        self.pad_id = self.eos_id if self._tok.pad_token_id is None else self._tok.pad_token_id
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        specials = {self.pad_id, self.bos_id, self.eos_id}
+        return self._tok.decode([i for i in ids if i not in specials])
+
+    def count(self, text: str) -> int:
+        return len(self.encode(text))
+
+
+def get_tokenizer(spec: Optional[str] = None, vocab_size: int = 512) -> Tokenizer:
+    """``spec`` is either None/"byte" for the hermetic byte tokenizer or a
+    local filesystem path to a HF tokenizer dir."""
+    if spec in (None, "byte"):
+        return ByteTokenizer(vocab_size=max(vocab_size, 512))
+    return HFTokenizer(spec)

@@ -1,0 +1,27 @@
+"""Hermetic test harness: force an 8-virtual-device CPU platform before the
+JAX backend initializes, so mesh/collective/sharding logic is exercised
+without TPUs (SURVEY.md §4's prescription).  Bench/serve on the real chip use
+the default platform instead."""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = [d for d in jax.devices() if d.platform == "cpu"]
+    assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs
