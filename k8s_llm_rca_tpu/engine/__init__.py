@@ -1,0 +1,2 @@
+from k8s_llm_rca_tpu.engine.engine import InferenceEngine, SequenceResult  # noqa: F401
+from k8s_llm_rca_tpu.engine.sampling import sample_tokens, SamplingParams  # noqa: F401

@@ -1,0 +1,316 @@
+"""Continuous-batching inference engine.
+
+The reference's serving model is one blocking OpenAI run at a time with an
+escalating 5 s poll (common/openai_generic_assistant.py:92-115) — strictly
+serial.  This engine replaces it with slot-based continuous batching
+(Orca/vLLM-style, re-designed for XLA's static shapes):
+
+- a fixed ``max_batch``-wide KV cache (models/llama.KVCache);
+- admission = per-sequence prefill into a free slot, padded to a static
+  bucket length (one compile per bucket, cached for the process lifetime);
+- every tick runs ONE jitted decode step for ALL active slots; sequences
+  join and leave the batch at token granularity;
+- completed slots are freed immediately and re-admitted from the pending
+  queue the same tick.
+
+Host<->device traffic per tick is one [B] token vector each way — everything
+else stays on device.  ``decode_scan`` amortizes even that for throughput
+benches by scanning N decode steps on device.
+
+Slot bookkeeping lives here on the host; it is the only writer of slot
+indices, which guards the silent-clamp semantics of dynamic_update_slice
+(see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
+from k8s_llm_rca_tpu.engine.sampling import SamplingParams, sample_tokens
+from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
+
+log = get_logger(__name__)
+
+
+@dataclass
+class SequenceResult:
+    seq_id: int
+    token_ids: List[int]
+    text: str
+    finish_reason: str          # "stop" | "eos" | "length"
+    prompt_tokens: int
+    completion_tokens: int
+
+
+@dataclass
+class _Active:
+    seq_id: int
+    slot: int
+    prompt_tokens: int
+    generated: List[int] = field(default_factory=list)
+    max_new_tokens: int = 256
+    stop_strings: Tuple[str, ...] = ()
+
+
+@dataclass
+class _Pending:
+    seq_id: int
+    prompt_ids: List[int]
+    max_new_tokens: int
+    stop_strings: Tuple[str, ...]
+
+
+class InferenceEngine:
+    """Single-host engine over one model replica (sharded or not)."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        params,
+        tokenizer: Tokenizer,
+    ):
+        self.model_cfg = model_cfg
+        self.engine_cfg = engine_cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.sampling = SamplingParams(
+            temperature=engine_cfg.temperature,
+            top_k=engine_cfg.top_k,
+            top_p=engine_cfg.top_p,
+        )
+
+        b = engine_cfg.max_batch
+        self.cache = llama.init_cache(model_cfg, b, engine_cfg.max_seq_len)
+        self.lengths = jnp.zeros((b,), jnp.int32)
+        self.cur_tokens = jnp.zeros((b,), jnp.int32)
+        self._key = jax.random.PRNGKey(engine_cfg.seed)
+
+        self._free_slots = list(range(b))
+        self._active: Dict[int, _Active] = {}       # slot -> state
+        self._pending: List[_Pending] = []
+        self._seq_counter = itertools.count()
+
+        self._prefill = jax.jit(llama.prefill, static_argnums=0)
+        self._decode = jax.jit(llama.decode_step, static_argnums=0)
+        self._sample = jax.jit(sample_tokens, static_argnums=2)
+
+        self._buckets = tuple(
+            s for s in sorted(set(engine_cfg.prefill_buckets))
+            if s <= engine_cfg.max_seq_len
+        ) or (engine_cfg.max_seq_len,)
+
+    # ------------------------------------------------------------------ api
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        stop_strings: Sequence[str] = (),
+    ) -> int:
+        """Queue a sequence; returns its seq_id.  Non-blocking."""
+        seq_id = next(self._seq_counter)
+        max_new = (self.engine_cfg.max_new_tokens
+                   if max_new_tokens is None else max_new_tokens)
+        prompt_ids = list(prompt_ids)
+        cap = self.engine_cfg.max_seq_len
+        # Fit prompt + generation into the slot: first shrink max_new to what
+        # the cache can hold after the prompt; if the prompt alone overflows,
+        # keep its TAIL (the task statement sits at the end of RCA prompts)
+        # while reserving at least cap//4 tokens of generation room.
+        # (Long-context CP/ring-attention prefill lifts this limit later.)
+        if len(prompt_ids) + max_new + 1 > cap:
+            reserve = min(max_new, max(1, cap // 4))
+            budget = cap - reserve - 1
+            if len(prompt_ids) > budget:
+                log.warning(
+                    "truncating prompt %d -> %d tokens (cache cap %d)",
+                    len(prompt_ids), budget, cap)
+                had_bos = prompt_ids[0] == self.tokenizer.bos_id
+                prompt_ids = prompt_ids[-budget:]
+                if had_bos:   # keep BOS conditioning after tail-truncation
+                    prompt_ids[0] = self.tokenizer.bos_id
+            max_new = min(max_new, cap - len(prompt_ids) - 1)
+        self._pending.append(
+            _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings)))
+        return seq_id
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active or self._pending)
+
+    def step(self) -> List[SequenceResult]:
+        """One engine tick: admit pending into free slots, then one decode
+        step for all active slots.  Returns sequences finished this tick."""
+        finished: List[SequenceResult] = []
+        while self._pending and self._free_slots:
+            early = self._admit(self._pending.pop(0))
+            if early is not None:        # first sampled token already terminal
+                finished.append(early)
+        if not self._active:
+            return finished
+
+        with METRICS.timer("engine.decode_step"):
+            self.cache, logits = self._decode(
+                self.model_cfg, self.params, self.cache,
+                self.cur_tokens, self.lengths)
+            self._key, sub = jax.random.split(self._key)
+            next_tokens = self._sample(logits, sub, self.sampling)
+        METRICS.inc("engine.decode_tokens", len(self._active))
+
+        active_slots = list(self._active)
+        self.lengths = self.lengths.at[jnp.asarray(active_slots)].add(1)
+        self.cur_tokens = next_tokens
+        host_next = np.asarray(next_tokens)
+        lengths_host = np.asarray(self.lengths)
+
+        for slot in active_slots:
+            st = self._active[slot]
+            token = int(host_next[slot])
+            st.generated.append(token)
+            reason = self._finish_reason(st, token, int(lengths_host[slot]))
+            if reason is not None:
+                finished.append(self._retire(slot, reason))
+        return finished
+
+    def run_to_completion(self) -> List[SequenceResult]:
+        """Pump until queue and slots drain; returns all finished sequences."""
+        out: List[SequenceResult] = []
+        while self.has_work:
+            out.extend(self.step())
+        return out
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+        stop_strings: Sequence[str] = (),
+    ) -> List[SequenceResult]:
+        """Batch convenience: submit all, pump, return in submit order."""
+        ids = [self.submit(p, max_new_tokens, stop_strings) for p in prompts]
+        results = {r.seq_id: r for r in self.run_to_completion()}
+        return [results[i] for i in ids]
+
+    # ------------------------------------------------------------- internals
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self.engine_cfg.max_seq_len
+
+    def _admit(self, req: _Pending) -> Optional[SequenceResult]:
+        slot = self._free_slots.pop(0)
+        n = len(req.prompt_ids)
+        bucket = self._bucket(n)
+        assert n <= bucket, f"prompt {n} exceeds largest bucket {bucket}"
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req.prompt_ids
+        with METRICS.timer("engine.prefill"):
+            self.cache, logits = self._prefill(
+                self.model_cfg, self.params, self.cache,
+                jnp.asarray(padded), jnp.int32(n), jnp.int32(slot))
+            self._key, sub = jax.random.split(self._key)
+            first = self._sample(logits, sub, self.sampling)
+        METRICS.inc("engine.prefill_tokens", n)
+
+        st = _Active(
+            seq_id=req.seq_id, slot=slot, prompt_tokens=n,
+            max_new_tokens=req.max_new_tokens, stop_strings=req.stop_strings)
+        token = int(first[0])
+        st.generated.append(token)
+        self._active[slot] = st
+        self.lengths = self.lengths.at[slot].set(n)
+        self.cur_tokens = self.cur_tokens.at[slot].set(token)
+        # the first sampled token may already terminate the sequence
+        reason = self._finish_reason(st, token, n)
+        if reason is not None:
+            return self._retire(slot, reason)
+        return None
+
+    def _finish_reason(self, st: _Active, token: int, length: int) -> Optional[str]:
+        if token == self.tokenizer.eos_id:
+            return "eos"
+        if len(st.generated) >= st.max_new_tokens:
+            return "length"
+        if length + 1 >= self.engine_cfg.max_seq_len:
+            return "length"
+        if st.stop_strings:
+            # decode only a bounded tail window: a token covers >= 1 char, so
+            # a window of max_stop_chars + 8 tokens always contains any stop
+            # string that just completed (avoids O(n^2) re-decoding).
+            window = max(len(s) for s in st.stop_strings) + 8
+            text = self.tokenizer.decode(st.generated[-window:])
+            for s in st.stop_strings:
+                if s in text:
+                    return "stop"
+        return None
+
+    def _retire(self, slot: int, reason: str) -> SequenceResult:
+        st = self._active.pop(slot)
+        self._free_slots.append(slot)
+        text = self.tokenizer.decode(st.generated)
+        if reason == "eos":
+            text = self.tokenizer.decode(st.generated[:-1])
+        elif reason == "stop":
+            for s in st.stop_strings:
+                idx = text.find(s)
+                if idx >= 0:
+                    text = text[:idx]
+                    break
+        return SequenceResult(
+            seq_id=st.seq_id,
+            token_ids=list(st.generated),
+            text=text,
+            finish_reason=reason,
+            prompt_tokens=st.prompt_tokens,
+            completion_tokens=len(st.generated),
+        )
+
+
+# ---------------------------------------------------------------------------
+# On-device multi-step decode (throughput path, used by bench.py)
+# ---------------------------------------------------------------------------
+
+
+def decode_scan(
+    cfg: ModelConfig,
+    params,
+    cache: llama.KVCache,
+    cur_tokens: jnp.ndarray,    # [B]
+    lengths: jnp.ndarray,       # [B]
+    key: jax.Array,
+    n_steps: int,
+    sampling: SamplingParams = SamplingParams(),
+    eos_id: int = -1,
+) -> Tuple[llama.KVCache, jnp.ndarray, jnp.ndarray]:
+    """Decode ``n_steps`` for the whole batch with zero host sync.
+
+    Returns (cache, tokens [n_steps, B], lengths).  Slots that hit ``eos_id``
+    stop advancing (their token repeats; host trims after the fact).
+    """
+
+    def body(carry, _):
+        cache, cur, lens, done, key = carry
+        cache, logits = llama.decode_step(cfg, params, cache, cur, lens)
+        key, sub = jax.random.split(key)
+        nxt = sample_tokens(logits, sub, sampling)
+        newly_done = done | (nxt == eos_id)
+        advance = jnp.logical_not(done)
+        cur = jnp.where(advance, nxt, cur)
+        lens = lens + advance.astype(jnp.int32)
+        return (cache, cur, lens, newly_done, key), cur
+
+    done0 = jnp.zeros_like(cur_tokens, dtype=bool)
+    (cache, _, lengths, _, _), toks = jax.lax.scan(
+        body, (cache, cur_tokens, lengths, done0, key), None, length=n_steps)
+    return cache, toks, lengths

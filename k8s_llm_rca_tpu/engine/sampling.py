@@ -1,0 +1,60 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+All branches are static-shape and jit-friendly; the per-slot PRNG key is
+split on device so a batched decode step stays one fused XLA computation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0   # 0 => greedy
+    top_k: int = 0             # 0 => disabled
+    top_p: float = 1.0         # 1 => disabled
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    vals, _ = jax.lax.top_k(logits, k)                    # [B, k]
+    kth = vals[:, -1:]                                     # [B, 1]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]     # desc
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens whose cumulative prob (exclusive) is < p; always keep top-1
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < p], axis=-1)
+    # threshold logit: smallest kept logit per row
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] fp32
+    key: jax.Array,
+    params: SamplingParams,
+) -> jnp.ndarray:
+    """Return sampled token ids [B].  ``params`` is static (baked into jit)."""
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.float32(params.temperature)
+    if params.top_k > 0:
+        scaled = _apply_top_k(scaled, params.top_k)
+    if params.top_p < 1.0:
+        scaled = _apply_top_p(scaled, params.top_p)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

@@ -1,0 +1,14 @@
+from k8s_llm_rca_tpu.serve.api import (  # noqa: F401
+    Assistant,
+    AssistantService,
+    GenericAssistant,
+    Message,
+    Run,
+    RunStatus,
+    Thread,
+)
+from k8s_llm_rca_tpu.serve.backend import (  # noqa: F401
+    EngineBackend,
+    LMBackend,
+    EchoBackend,
+)

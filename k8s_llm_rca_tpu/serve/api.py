@@ -1,0 +1,365 @@
+"""Local assistants API: Assistant / Thread / Message / Run on a local backend.
+
+This is the drop-in replacement surface for the reference's
+``OpenAIGenericAssistant`` (common/openai_generic_assistant.py) — the same
+object model and the same 13 client methods — except the compute behind it is
+the in-tree TPU engine instead of HTTPS to api.openai.com:
+
+- the run-state machine is preserved exactly: ``queued | in_progress |
+  completed | cancelled | failed | expired`` (reference :100-112 branches on
+  these);
+- ``get_token_usage(tmin, tmax, limit)`` keeps the reference's window
+  semantics (:117-135): sum usage over runs whose created_at AND completed_at
+  both fall in ``[tmin, tmax)``, newest-first, capped at ``limit``;
+- ``wait_get_last_k_message`` keeps the blocking contract but pumps the
+  scheduler instead of sleeping 5·i seconds per poll (:92-115) — the 5 s
+  polling floor per LLM call simply disappears;
+- message listings are newest-first and messages expose
+  ``.content[0].text.value`` so stage code written against the OpenAI shapes
+  ports without edits (reference usage: find_srckind_metapath_neo4j.py:189).
+
+Threads support concurrent runs from one thread (the reference serializes
+per-thread; SURVEY §3.4 notes stage 3 issues independent per-entity audits on
+a shared thread — here they can overlap in the batch).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from k8s_llm_rca_tpu.serve.backend import GenOptions, LMBackend
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+
+class RunStatus:
+    QUEUED = "queued"
+    IN_PROGRESS = "in_progress"
+    COMPLETED = "completed"
+    CANCELLED = "cancelled"
+    FAILED = "failed"
+    EXPIRED = "expired"
+
+    TERMINAL = (COMPLETED, CANCELLED, FAILED, EXPIRED)
+
+
+# --- OpenAI-shaped message content (stage code reads .content[0].text.value)
+
+
+@dataclass
+class _Text:
+    value: str
+
+
+@dataclass
+class _ContentPart:
+    text: _Text
+    type: str = "text"
+
+
+@dataclass
+class Message:
+    id: str
+    role: str
+    raw_content: str
+    created_at: float
+    content: List[_ContentPart] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.content:
+            self.content = [_ContentPart(text=_Text(value=self.raw_content))]
+
+
+@dataclass
+class MessageList:
+    data: List[Message]        # newest first, like the OpenAI listing
+
+
+@dataclass
+class Assistant:
+    id: str
+    name: str
+    instructions: str
+    model: str
+    gen: GenOptions = field(default_factory=GenOptions)
+
+
+@dataclass
+class Thread:
+    id: str
+    messages: List[Message] = field(default_factory=list)  # oldest first
+
+
+@dataclass
+class Run:
+    id: str
+    thread_id: str
+    assistant_id: str
+    status: str = RunStatus.QUEUED
+    created_at: Optional[int] = None
+    completed_at: Optional[int] = None
+    usage: Dict[str, int] = field(default_factory=lambda: {
+        "prompt_tokens": 0, "completion_tokens": 0, "total_tokens": 0})
+    error: Optional[str] = None
+    # book-keeping
+    instructions_override: Optional[str] = None
+    backend_handle: Optional[int] = None
+    deadline: Optional[float] = None
+    response_message_id: Optional[str] = None
+
+
+def render_prompt(assistant: Assistant, thread: Thread,
+                  instructions_override: Optional[str] = None) -> str:
+    """Chat-template rendering of instructions + thread history.
+
+    The whole thread is replayed every run, matching the reference's
+    monotonically growing assistant threads (SURVEY §5 long-context note) —
+    this is precisely what makes CP/ring-attention prefill worth having.
+    """
+    instructions = instructions_override or assistant.instructions
+    parts = [f"<|system|>\n{instructions}\n"]
+    for m in thread.messages:
+        parts.append(f"<|{m.role}|>\n{m.raw_content}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
+class AssistantService:
+    """The 'server': owns assistants/threads/runs and drives an LMBackend."""
+
+    def __init__(self, backend: LMBackend, run_timeout_s: float = 600.0):
+        self.backend = backend
+        self.run_timeout_s = run_timeout_s
+        self.assistants: Dict[str, Assistant] = {}
+        self.threads: Dict[str, Thread] = {}
+        self.runs: Dict[str, Run] = {}
+        self._thread_runs: Dict[str, List[str]] = {}
+        self._inflight: Dict[int, str] = {}   # backend handle -> run id
+        self._ids = itertools.count()
+
+    def _next_id(self, prefix: str) -> str:
+        return f"{prefix}_{next(self._ids):08d}"
+
+    # ------------------------------------------------------------ lifecycle
+
+    def create_assistant(self, instructions: str, name: str,
+                         model: str = "local",
+                         gen: Optional[GenOptions] = None) -> Assistant:
+        a = Assistant(self._next_id("asst"), name, instructions, model,
+                      gen or GenOptions())
+        self.assistants[a.id] = a
+        return a
+
+    def retrieve_assistant(self, assistant_id: str) -> Assistant:
+        return self.assistants[assistant_id]
+
+    def create_thread(self) -> Thread:
+        t = Thread(self._next_id("thread"))
+        self.threads[t.id] = t
+        self._thread_runs[t.id] = []
+        return t
+
+    def retrieve_thread(self, thread_id: str) -> Thread:
+        return self.threads[thread_id]
+
+    def add_message(self, thread_id: str, content: str,
+                    role: str = "user") -> Message:
+        m = Message(self._next_id("msg"), role, content, time.time())
+        self.threads[thread_id].messages.append(m)
+        return m
+
+    def create_run(self, thread_id: str, assistant_id: str,
+                   instructions: Optional[str] = None,
+                   gen: Optional[GenOptions] = None) -> Run:
+        assistant = self.assistants[assistant_id]
+        run = Run(self._next_id("run"), thread_id, assistant_id,
+                  created_at=int(time.time()),
+                  instructions_override=instructions)
+        run.deadline = time.time() + self.run_timeout_s
+        self.runs[run.id] = run
+        self._thread_runs[thread_id].append(run.id)
+
+        prompt = render_prompt(assistant, self.threads[thread_id], instructions)
+        opts = gen or assistant.gen
+        run.usage["prompt_tokens"] = self.backend.count_tokens(prompt)
+        run.backend_handle = self.backend.start(prompt, opts)
+        run.status = RunStatus.IN_PROGRESS
+        self._inflight[run.backend_handle] = run.id
+        METRICS.inc("serve.runs_started")
+        return run
+
+    def retrieve_run(self, run_id: str) -> Run:
+        self._pump()
+        return self.runs[run_id]
+
+    def cancel_run(self, run_id: str) -> Run:
+        run = self.runs[run_id]
+        if run.status not in RunStatus.TERMINAL:
+            self.backend.cancel(run.backend_handle)
+            run.status = RunStatus.CANCELLED
+            run.completed_at = int(time.time())
+            self._inflight.pop(run.backend_handle, None)
+        return run
+
+    def list_runs(self, thread_id: str, limit: int = 20,
+                  order: str = "desc") -> List[Run]:
+        ids = self._thread_runs.get(thread_id, [])
+        runs = [self.runs[i] for i in ids]
+        if order == "desc":
+            runs = runs[::-1]
+        return runs[:limit]
+
+    def list_messages(self, thread_id: str, limit: Optional[int] = None
+                      ) -> MessageList:
+        msgs = self.threads[thread_id].messages[::-1]  # newest first
+        if limit is not None:
+            msgs = msgs[:limit]
+        return MessageList(data=msgs)
+
+    # ------------------------------------------------------------ execution
+
+    def _pump(self) -> None:
+        """Advance the backend and settle any finished runs.  O(in-flight
+        runs), not O(all runs ever created)."""
+        results = self.backend.pump()
+        now = time.time()
+        for handle, run_id in list(self._inflight.items()):
+            run = self.runs[run_id]
+            if handle in results:
+                res = results[handle]
+                if res.error is not None:
+                    run.status = RunStatus.FAILED
+                    run.error = res.error
+                else:
+                    run.status = RunStatus.COMPLETED
+                    msg = Message(self._next_id("msg"), "assistant",
+                                  res.text, now)
+                    self.threads[run.thread_id].messages.append(msg)
+                    run.response_message_id = msg.id
+                if res.prompt_tokens is not None:
+                    # prefer the engine's ground truth (includes BOS, forced
+                    # prefix, and any overflow truncation)
+                    run.usage["prompt_tokens"] = res.prompt_tokens
+                run.usage["completion_tokens"] = res.completion_tokens
+                run.usage["total_tokens"] = (
+                    run.usage["prompt_tokens"] + res.completion_tokens)
+                run.completed_at = int(time.time())
+                del self._inflight[handle]
+            elif run.deadline is not None and now > run.deadline:
+                self.backend.cancel(run.backend_handle)
+                run.status = RunStatus.EXPIRED
+                run.completed_at = int(time.time())
+                del self._inflight[handle]
+
+    def wait_run(self, run_id: str, timeout_s: Optional[float] = None) -> Run:
+        run = self.runs[run_id]
+        t0 = time.time()
+        while run.status not in RunStatus.TERMINAL:
+            self._pump()
+            if run.status in RunStatus.TERMINAL:
+                break
+            if not self.backend.busy(run.backend_handle):
+                # backend lost the handle without a result
+                run.status = RunStatus.FAILED
+                run.error = "backend dropped the run"
+                break
+            if timeout_s is not None and time.time() - t0 > timeout_s:
+                run.status = RunStatus.EXPIRED
+                run.completed_at = int(time.time())
+                break
+        return run
+
+
+class GenericAssistant:
+    """Reference-compatible client: the 13 methods of
+    common/openai_generic_assistant.py:10-135, same names, same shapes."""
+
+    def __init__(self, service: AssistantService):
+        self.service = service
+        self.assistant: Optional[Assistant] = None
+        self.thread: Optional[Thread] = None
+        self.message: Optional[Message] = None
+        self.run: Optional[Run] = None
+
+    # --- lifecycle (reference :16-35)
+
+    def create_assistant(self, instructions: str, name: str,
+                         model: str = "local",
+                         gen: Optional[GenOptions] = None) -> None:
+        self.assistant = self.service.create_assistant(
+            instructions, name, model, gen)
+
+    def retrieve_assistant(self, assistant_id: str) -> None:
+        self.assistant = self.service.retrieve_assistant(assistant_id)
+
+    def create_thread(self) -> None:
+        self.thread = self.service.create_thread()
+
+    def retrieve_thread(self, thread_id: str) -> None:
+        self.thread = self.service.retrieve_thread(thread_id)
+
+    # --- messages & runs (reference :37-58)
+
+    def add_message(self, content: str) -> None:
+        self.message = self.service.add_message(self.thread.id, content)
+
+    def run_assistant(self, instructions: Optional[str] = None) -> None:
+        self.run = self.service.create_run(
+            self.thread.id, self.assistant.id, instructions)
+
+    def get_run_status(self) -> Run:
+        return self.service.retrieve_run(self.run.id)
+
+    # --- listings (reference :60-90)
+
+    def display_response(self) -> None:
+        print(self.get_last_message().data[0])
+
+    def get_last_message(self) -> MessageList:
+        return self.service.list_messages(self.thread.id, limit=1)
+
+    def get_all_message(self) -> MessageList:
+        return self.service.list_messages(self.thread.id, limit=20)
+
+    def get_last_k_message(self, num: int) -> MessageList:
+        return self.service.list_messages(self.thread.id, limit=num)
+
+    # --- blocking wait (reference :92-115; polling becomes a pumped future)
+
+    def wait_get_last_k_message(self, num: int = 1) -> Optional[MessageList]:
+        run = self.service.wait_run(self.run.id)
+        if run.status == RunStatus.COMPLETED:
+            msgs = self.get_last_k_message(num)
+            # Concurrent runs on a shared thread may have settled in the same
+            # pump; make sure data[0] is THIS run's reply (stage code reads
+            # data[0].content[0].text.value as the awaited answer).
+            if run.response_message_id is not None and (
+                    not msgs.data or msgs.data[0].id != run.response_message_id):
+                all_msgs = self.service.list_messages(self.thread.id)
+                mine = [m for m in all_msgs.data
+                        if m.id == run.response_message_id]
+                rest = [m for m in all_msgs.data
+                        if m.id != run.response_message_id]
+                msgs = MessageList(data=(mine + rest)[:num])
+            return msgs
+        log.warning("run %s terminated with status=%s error=%s",
+                    run.id, run.status, run.error)
+        return None
+
+    # --- token accounting (reference :117-135, same window semantics)
+
+    def get_token_usage(self, tmin: int, tmax: int, limit: int = 20
+                        ) -> Dict[str, int]:
+        usage = {"prompt_tokens": 0, "completion_tokens": 0, "total_tokens": 0}
+        for run in self.service.list_runs(self.thread.id, limit=limit,
+                                          order="desc"):
+            if (run.created_at is not None and run.completed_at is not None
+                    and tmin <= run.created_at < tmax
+                    and tmin <= run.completed_at < tmax):
+                for k in usage:
+                    usage[k] += run.usage[k]
+        return usage

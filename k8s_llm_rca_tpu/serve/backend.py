@@ -1,0 +1,148 @@
+"""LM backends for the assistants service.
+
+``EngineBackend`` is the real path: requests stream through the
+continuous-batching InferenceEngine, so concurrent runs (e.g. stage 3's
+per-entity audits, SURVEY §3.4) share decode steps in one batch.
+
+``EchoBackend`` is a trivial deterministic backend for serve-layer tests.
+The RCA-aware scripted oracle lives in rca/oracle.py (it needs the stage
+prompt contracts, which belong to the rca layer).
+
+Forced prefixes implement the fenced-output contracts on the engine side:
+the fence opener (e.g. "```json\\n") is prefilled as forced tokens and the
+closing fence is a stop string, so the model cannot emit an unfenced reply —
+this kills the JSONDecodeError retry loop the reference needs
+(test_all.py:70-76).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Protocol, Tuple
+
+from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
+
+
+@dataclass(frozen=True)
+class GenOptions:
+    max_new_tokens: int = 256
+    stop: Tuple[str, ...] = ()
+    forced_prefix: str = ""     # emitted verbatim, prefilled as forced tokens
+    suffix: str = ""            # appended verbatim after generation stops
+
+
+@dataclass
+class BackendResult:
+    text: str
+    completion_tokens: int
+    prompt_tokens: Optional[int] = None   # actual prefilled tokens if known
+    error: Optional[str] = None
+
+
+class LMBackend(Protocol):
+    def start(self, prompt: str, opts: GenOptions) -> int: ...
+    def pump(self) -> Dict[int, BackendResult]: ...
+    def busy(self, handle: int) -> bool: ...
+    def cancel(self, handle: int) -> None: ...
+    def count_tokens(self, text: str) -> int: ...
+
+
+class EngineBackend:
+    """Continuous-batching engine behind the assistants API."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        self.tokenizer = engine.tokenizer
+        self._handles = itertools.count()
+        self._seq_to_handle: Dict[int, int] = {}
+        self._opts: Dict[int, GenOptions] = {}
+        self._live: Dict[int, bool] = {}
+
+    def start(self, prompt: str, opts: GenOptions) -> int:
+        handle = next(self._handles)
+        ids = self.tokenizer.encode(prompt + opts.forced_prefix, add_bos=True)
+        seq_id = self.engine.submit(
+            ids, max_new_tokens=opts.max_new_tokens, stop_strings=opts.stop)
+        self._seq_to_handle[seq_id] = handle
+        self._opts[handle] = opts
+        self._live[handle] = True
+        return handle
+
+    def pump(self) -> Dict[int, BackendResult]:
+        results: Dict[int, BackendResult] = {}
+        if not self.engine.has_work:
+            return results
+        for res in self.engine.step():
+            handle = self._seq_to_handle.pop(res.seq_id, None)
+            if handle is None:
+                continue
+            opts = self._opts.pop(handle, GenOptions())
+            live = self._live.pop(handle, False)
+            if not live:                   # cancelled: drop, don't leak
+                continue
+            text = opts.forced_prefix + res.text + opts.suffix
+            results[handle] = BackendResult(
+                text=text,
+                completion_tokens=res.completion_tokens,
+                prompt_tokens=res.prompt_tokens)
+        return results
+
+    def busy(self, handle: int) -> bool:
+        return self._live.get(handle, False)
+
+    def cancel(self, handle: int) -> None:
+        # the engine slot keeps decoding until its natural end; the result is
+        # simply dropped.  (Slot-level preemption lands with the paged cache.)
+        if handle in self._live:
+            self._live[handle] = False
+
+    def count_tokens(self, text: str) -> int:
+        return self.tokenizer.count(text)
+
+
+class EchoBackend:
+    """Deterministic test backend: replies with a fixed or prompt-derived
+    string after ``delay_pumps`` pump calls (to exercise the run-state
+    machine's in_progress window)."""
+
+    def __init__(self, tokenizer: Tokenizer, reply: Optional[str] = None,
+                 delay_pumps: int = 0, fail: bool = False):
+        self.tokenizer = tokenizer
+        self.reply = reply
+        self.fail = fail
+        self.delay_pumps = delay_pumps
+        self._handles = itertools.count()
+        self._inflight: Dict[int, Tuple[str, GenOptions, int]] = {}
+
+    def start(self, prompt: str, opts: GenOptions) -> int:
+        handle = next(self._handles)
+        self._inflight[handle] = (prompt, opts, self.delay_pumps)
+        return handle
+
+    def pump(self) -> Dict[int, BackendResult]:
+        results: Dict[int, BackendResult] = {}
+        for handle in list(self._inflight):
+            prompt, opts, remaining = self._inflight[handle]
+            if remaining > 0:
+                self._inflight[handle] = (prompt, opts, remaining - 1)
+                continue
+            del self._inflight[handle]
+            if self.fail:
+                results[handle] = BackendResult("", 0, error="echo backend failure")
+                continue
+            text = self.reply if self.reply is not None else f"echo: {prompt[-64:]}"
+            text = opts.forced_prefix + text + opts.suffix
+            results[handle] = BackendResult(
+                text=text, completion_tokens=self.tokenizer.count(text))
+        return results
+
+    def busy(self, handle: int) -> bool:
+        return handle in self._inflight
+
+    def cancel(self, handle: int) -> None:
+        self._inflight.pop(handle, None)
+
+    def count_tokens(self, text: str) -> int:
+        return self.tokenizer.count(text)

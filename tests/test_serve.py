@@ -1,0 +1,134 @@
+"""Serve-layer tests: run-state machine, message shapes, token windows —
+the contracts stage code depends on (reference:
+common/openai_generic_assistant.py:92-135)."""
+
+import time
+
+import jax
+import pytest
+
+from k8s_llm_rca_tpu.config import TINY, EngineConfig
+from k8s_llm_rca_tpu.engine import InferenceEngine
+from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.serve import (
+    AssistantService, EchoBackend, EngineBackend, GenericAssistant, RunStatus,
+)
+from k8s_llm_rca_tpu.serve.backend import GenOptions
+from k8s_llm_rca_tpu.utils import get_tokenizer
+
+
+@pytest.fixture()
+def echo_service():
+    tok = get_tokenizer()
+    return AssistantService(EchoBackend(tok, reply="the answer"))
+
+
+def make_client(service, name="helper"):
+    c = GenericAssistant(service)
+    c.create_assistant("you are a test assistant", name)
+    c.create_thread()
+    return c
+
+
+def test_run_lifecycle_completed(echo_service):
+    c = make_client(echo_service)
+    c.add_message("question?")
+    c.run_assistant()
+    assert c.run.status in (RunStatus.QUEUED, RunStatus.IN_PROGRESS)
+    msgs = c.wait_get_last_k_message(1)
+    assert msgs is not None
+    # newest-first, OpenAI content shape
+    assert msgs.data[0].content[0].text.value == "the answer"
+    run = c.get_run_status()
+    assert run.status == RunStatus.COMPLETED
+    assert run.usage["prompt_tokens"] > 0
+    assert run.usage["total_tokens"] == (
+        run.usage["prompt_tokens"] + run.usage["completion_tokens"])
+    # thread history: system-less, user then assistant, oldest first
+    roles = [m.role for m in c.thread.messages]
+    assert roles == ["user", "assistant"]
+
+
+def test_run_failure_returns_none():
+    tok = get_tokenizer()
+    service = AssistantService(EchoBackend(tok, fail=True))
+    c = make_client(service)
+    c.add_message("q")
+    c.run_assistant()
+    assert c.wait_get_last_k_message(1) is None
+    assert c.get_run_status().status == RunStatus.FAILED
+
+
+def test_run_expiry():
+    tok = get_tokenizer()
+    service = AssistantService(EchoBackend(tok, delay_pumps=10 ** 9),
+                               run_timeout_s=0.05)
+    c = make_client(service)
+    c.add_message("q")
+    c.run_assistant()
+    time.sleep(0.06)
+    assert c.wait_get_last_k_message(1) is None
+    assert c.get_run_status().status == RunStatus.EXPIRED
+
+
+def test_cancel_run(echo_service):
+    c = make_client(echo_service)
+    c.add_message("q")
+    c.run_assistant()
+    c.service.cancel_run(c.run.id)
+    assert c.get_run_status().status == RunStatus.CANCELLED
+    assert c.wait_get_last_k_message(1) is None
+
+
+def test_token_usage_window(echo_service):
+    """Window semantics of reference :117-135: created_at AND completed_at
+    in [tmin, tmax)."""
+    c = make_client(echo_service)
+    t0 = int(time.time())
+    c.add_message("q1")
+    c.run_assistant()
+    c.wait_get_last_k_message(1)
+    t1 = int(time.time()) + 1
+    usage = c.get_token_usage(t0, t1)
+    assert usage["total_tokens"] > 0
+    # empty window before the run
+    assert c.get_token_usage(t0 - 100, t0 - 50)["total_tokens"] == 0
+    # half-open: window ending at created_at excludes the run
+    run = c.get_run_status()
+    assert c.get_token_usage(t0 - 100, run.created_at)["total_tokens"] == 0
+
+
+def test_forced_prefix_and_suffix(echo_service):
+    c = GenericAssistant(echo_service)
+    c.create_assistant("a", "fenced",
+                       gen=GenOptions(forced_prefix="```json\n", suffix="\n```"))
+    c.create_thread()
+    c.add_message("emit")
+    c.run_assistant()
+    text = c.wait_get_last_k_message(1).data[0].content[0].text.value
+    assert text.startswith("```json\n") and text.endswith("\n```")
+
+
+def test_engine_backend_end_to_end():
+    """Two clients share one service + engine; both runs complete through
+    the continuous batch."""
+    cfg = TINY
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer()
+    engine = InferenceEngine(
+        cfg, EngineConfig(max_batch=4, max_seq_len=256,
+                          prefill_buckets=(64, 128), max_new_tokens=8),
+        params, tok)
+    service = AssistantService(EngineBackend(engine))
+    c1, c2 = make_client(service, "a"), make_client(service, "b")
+    c1.add_message("first incident")
+    c2.add_message("second incident")
+    c1.run_assistant()
+    c2.run_assistant()
+    m1 = c1.wait_get_last_k_message(1)
+    m2 = c2.wait_get_last_k_message(1)
+    assert m1 is not None and m2 is not None
+    assert c1.get_run_status().status == RunStatus.COMPLETED
+    assert c2.get_run_status().status == RunStatus.COMPLETED
+    u = c1.get_token_usage(0, int(time.time()) + 10)
+    assert u["completion_tokens"] > 0
