@@ -1,0 +1,57 @@
+"""Graph query executors: one protocol, two backends.
+
+``InMemoryGraphExecutor`` runs the mini-Cypher interpreter over a canned
+Graph — the hermetic default.  ``Neo4jQueryExecutor`` is a thin param-safe
+bolt client equivalent to the reference's (common/neo4j_query_executor.py:6-24),
+import-gated so the hermetic path never touches the neo4j driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Protocol
+
+from k8s_llm_rca_tpu.graph import cypher
+from k8s_llm_rca_tpu.graph.cypher import CypherSyntaxError  # noqa: F401 (re-export)
+from k8s_llm_rca_tpu.graph.store import Graph, Record
+
+
+class GraphQueryExecutor(Protocol):
+    def run_query(self, query: str,
+                  parameters: Optional[Dict[str, Any]] = None) -> List[Record]: ...
+    def close(self) -> None: ...
+
+
+class InMemoryGraphExecutor:
+    def __init__(self, graph: Graph):
+        self.graph = graph
+
+    @classmethod
+    def from_dump_file(cls, path: str) -> "InMemoryGraphExecutor":
+        return cls(Graph.load(path))
+
+    def run_query(self, query: str,
+                  parameters: Optional[Dict[str, Any]] = None) -> List[Record]:
+        return cypher.run_query(self.graph, query, parameters)
+
+    def close(self) -> None:
+        pass
+
+
+class Neo4jQueryExecutor:
+    """Bolt client matching the reference's executor surface: eager
+    ``run_query`` returning list(records), ``close``, connectivity verified
+    at construction (reference :7-9,15-24)."""
+
+    def __init__(self, uri: str, user: str, password: str):
+        from neo4j import GraphDatabase  # deferred: optional dependency
+
+        self.driver = GraphDatabase.driver(uri, auth=(user, password))
+        self.driver.verify_connectivity()
+
+    def run_query(self, query: str,
+                  parameters: Optional[Dict[str, Any]] = None):
+        with self.driver.session() as session:
+            return list(session.run(query, parameters))
+
+    def close(self) -> None:
+        self.driver.close()
