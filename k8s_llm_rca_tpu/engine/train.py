@@ -1,0 +1,70 @@
+"""Training step: next-token cross-entropy + optax update, mesh-sharded.
+
+The reference has no training at all (weights live behind the OpenAI API);
+this exists so the framework can fine-tune its RCA models in-tree and so the
+multi-chip sharding path has a full fwd+bwd+update graph to validate
+(__graft_entry__.dryrun_multichip jits this over a real dp x tp mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_llm_rca_tpu.config import ModelConfig
+from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.runtime.sharding import llama_param_specs, shard_pytree
+
+
+def next_token_loss(cfg: ModelConfig, params, tokens: jnp.ndarray,
+                    loss_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mean CE of predicting tokens[:, 1:] from tokens[:, :-1]."""
+    logits = llama.forward(cfg, params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        mask = loss_mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def make_train_step(cfg: ModelConfig,
+                    optimizer: optax.GradientTransformation
+                    ) -> Callable:
+    """Jittable (params, opt_state, tokens) -> (params, opt_state, loss).
+    Sharding comes from the argument placements (GSPMD propagation)."""
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: next_token_loss(cfg, p, tokens))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_sharded_train_state(cfg: ModelConfig, mesh: Mesh,
+                             optimizer: optax.GradientTransformation,
+                             seed: int = 0) -> Tuple[Any, Any]:
+    """Params sharded per llama_param_specs (TP over 'model', EP over
+    'expert'); optimizer state inherits the param shardings leaf-wise."""
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    specs = llama_param_specs(cfg)
+    params = shard_pytree(params, specs, mesh)
+    opt_state = jax.jit(
+        optimizer.init,
+        out_shardings=None)(params)   # placements propagate from params
+    return params, opt_state
+
+
+def shard_batch(tokens, mesh: Mesh):
+    """Batch dim over 'data' (DP); sequence stays whole here — sequence
+    sharding (SP/CP) is applied inside the attention modules in parallel/."""
+    return jax.device_put(
+        tokens, NamedSharding(mesh, P("data", None)))

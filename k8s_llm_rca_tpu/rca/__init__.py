@@ -1,0 +1,3 @@
+from k8s_llm_rca_tpu.rca.pipeline import (  # noqa: F401
+    RCAPipeline, IncidentResult,
+)

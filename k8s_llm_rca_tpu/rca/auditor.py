@@ -1,0 +1,263 @@
+"""RCA stage 3 — temporal state audit and final report.
+
+Behavior-equivalent to the reference's check_state package
+(check_state/analyze_root_cause.py):
+
+- the analyzer assistant is seeded with the STATE rule ("an entity without a
+  STATE node is a clear error") and the audit task protocol (:6-46);
+- temporal lookups join entity->STATE through HasState with half-open
+  ``[tmin, tmax)`` interval predicates — loose (interval overlap) and strict
+  (point-in-interval) variants (:49-79);
+- per-entity audit: a missing STATE fabricates an "apparent error" clue
+  naming the entity (name resolved through the 5-way key switch) and seeds
+  it into the analyzer thread as evidence; present STATEs get one semantic
+  LLM round-trip each over a 12-field projection (:155-250);
+- the statepath walk accumulates per-entity clues, then one summary run
+  demands per-kind relevance scores 0-10, a conclusion, and a kubectl/bash
+  resolution in a fixed JSON shape (:82-150).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from k8s_llm_rca_tpu.rca import entity
+from k8s_llm_rca_tpu.serve.api import AssistantService, GenericAssistant
+from k8s_llm_rca_tpu.serve.backend import GenOptions
+from k8s_llm_rca_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ANALYZER_INSTRUCTIONS = (
+    "You are an expert in Kubernetes state analysis: given a JSON snapshot "
+    "of a k8s object you find misconfigurations and decide whether they "
+    "relate to a given error message.")
+
+STATE_RULE = """\
+Rule: in this Kubernetes system every entity must have a corresponding STATE
+node capturing its existence and status.  An entity with no STATE node in the
+relevant time range is a clear error — the entity does not exist or its
+creation failed.  This applies uniformly to native resources (Secrets,
+ConfigMaps, Pods, ...) and external ones (nfs directories, hostPath
+directories, images, ...)."""
+
+TASK_PROTOCOL = """\
+Audit protocol: you will repeatedly receive (1) a JSON string with the
+current state of one k8s object and (2) an incident error message.  For each:
+parse the JSON; scrutinize 'spec' and 'status' (or the other significant
+fields when those are absent); decide whether anything in the state aligns
+with the error message; explain the connection or state clearly that the
+object looks unrelated; keep each reply a concise list of concrete clues
+with resource names and numbers."""
+
+
+def setup_state_semantic_analyzer(service: AssistantService,
+                                  model: str = "local",
+                                  max_new_tokens: int = 512) -> GenericAssistant:
+    analyzer = GenericAssistant(service)
+    analyzer.create_assistant(
+        ANALYZER_INSTRUCTIONS, "k8s-state-semantic-analyzer", model,
+        gen=GenOptions(max_new_tokens=max_new_tokens))
+    analyzer.create_thread()
+    analyzer.add_message(STATE_RULE)
+    analyzer.add_message(TASK_PROTOCOL)
+    return analyzer
+
+
+# ---------------------------------------------------------------------------
+# temporal state queries (string builders, matching the reference signatures;
+# values are repr-escaped rather than f-string-injected raw)
+# ---------------------------------------------------------------------------
+
+
+def find_loose_states(entity_kind: str, entity_id: str,
+                      tmin: str, tmax: str, limit: int = 10) -> str:
+    """[E.tmin, E.tmax) must overlap [S.tmin, S.tmax)."""
+    state_kind = entity_kind.upper()
+    return f"""
+    MATCH (n1:{entity_kind})-[r1:HasState]->(n2:{state_kind})
+    WHERE n1.id = {entity_id!r}
+    AND r1.tmin <= {tmax!r} AND r1.tmax > {tmin!r}
+    RETURN n2
+    LIMIT {limit};
+    """
+
+
+def find_strict_states(entity_kind: str, entity_id: str,
+                       timestamp: str, limit: int = 10) -> str:
+    """Event timestamp must fall in [S.tmin, S.tmax).  Half-open on the
+    right so one timestamp lands in exactly one interval (the reference
+    documents this rationale at :62-68)."""
+    state_kind = entity_kind.upper()
+    return f"""
+    MATCH (n1:{entity_kind})-[r1:HasState]->(n2:{state_kind})
+    WHERE n1.id = {entity_id!r}
+    AND r1.tmin <= {timestamp!r} AND r1.tmax > {timestamp!r}
+    RETURN n2
+    LIMIT {limit};
+    """
+
+
+def ad_hoc_find_entity_name(entity_kind: str, entity_id: str,
+                            query_executor) -> str:
+    records = query_executor.run_query(f"""
+    MATCH (n1:{entity_kind})
+    WHERE n1.id = {entity_id!r}
+    RETURN n1
+    LIMIT 1
+    """)
+    if not records:
+        return entity_id
+    return entity.entity_name(records[0]["n1"], default=entity_id)
+
+
+# ---------------------------------------------------------------------------
+# semantic audit
+# ---------------------------------------------------------------------------
+
+IMPORTANT_FIELDS = ("status", "spec", "path", "server", "subsets", "roleRef",
+                    "subjects", "rules", "webhooks", "secrets", "data",
+                    "metadata")
+
+
+def check_semantic(state_node, error_message: str,
+                   analyzer: GenericAssistant) -> str:
+    """One semantic LLM round-trip for one STATE node, prompt projected onto
+    the important fields to keep the context small."""
+    projection = {k: state_node[k] for k in IMPORTANT_FIELDS
+                  if state_node[k] is not None}
+    kind = state_node["kind"]
+    prompt = f"""\
+The following JSON comes from a {kind} object.  Focus on the 'spec' and
+'status' fields (or other relevant fields if those are absent) and list
+clues connecting it to the error message; ignore resolutions for now.
+The error message is:
+{error_message}
+
+The JSON is:
+{projection}
+"""
+    analyzer.add_message(prompt)
+    analyzer.run_assistant()
+    messages = analyzer.wait_get_last_k_message(1)
+    if messages is None:
+        raise RuntimeError(
+            f"analyzer run ended in state {analyzer.get_run_status().status}")
+    return messages.data[0].content[0].text.value
+
+
+def check_states_of_entity(entity_kind: str, entity_id: str,
+                           error_message: str, timestamp: str,
+                           query_executor,
+                           analyzer: GenericAssistant) -> List[str]:
+    """Audit one entity: missing STATE -> fabricated apparent-error clue
+    pushed into the analyzer thread; present STATEs -> one semantic
+    round-trip each."""
+    records = query_executor.run_query(
+        find_strict_states(entity_kind, entity_id, timestamp))
+    clues: List[str] = []
+    if not records:
+        entity_name = ad_hoc_find_entity_name(entity_kind, entity_id,
+                                              query_executor)
+        clue = (f"{entity_kind} ({entity_id}): there is not a STATE "
+                f"({entity_kind.upper()}) node corresponding to the Entity "
+                f"({entity_kind}) node, which is an apparent error. we "
+                f"confirm that {entity_name} does not exist.")
+        clues.append(clue)
+        analyzer.add_message(clue)        # evidence for the summary run
+    else:
+        for record in records:
+            state_node = record["n2"]
+            semantic = check_semantic(state_node, error_message, analyzer)
+            clues.append(f"{state_node['kind'].upper()}({state_node['id']}): "
+                         f"{semantic}")
+    for clue in clues:
+        log.info("clue: %s", clue)
+    return clues
+
+
+def check_states_existence_and_semantic(query_executor, cypher_query: str,
+                                        analyzer: GenericAssistant,
+                                        error_message: str) -> List[str]:
+    """Legacy single-query variant kept for stage-isolated harnesses
+    (reference :155-170, still used by test_check_state.py:48)."""
+    clues: List[str] = []
+    records = query_executor.run_query(cypher_query)
+    if not records:
+        clues.append("There is not a STATE node corresponds to the Entity node")
+    else:
+        for record in records:
+            state_node = record["n2"]
+            semantic = check_semantic(state_node, error_message, analyzer)
+            clues.append(f"{state_node['kind']}({state_node['id']}): {semantic}")
+    return clues
+
+
+# ---------------------------------------------------------------------------
+# statepath walk + report
+# ---------------------------------------------------------------------------
+
+REPORT_SHAPE = """\
+Format the report in this JSON style:
+{
+"summary": [
+        {
+        "kind": "<k8s object kind>",
+        "explanation": "<brief explanation with specific evidence>",
+        "relevance_score": "<0-10>"
+        },
+        ...
+        ],
+"conclusion": "<summary of the overall findings>",
+"resolution": "<actions to resolve the error, with kubectl/bash command>"
+}
+"""
+
+
+def _is_node(ele) -> bool:
+    return hasattr(ele, "labels") and hasattr(ele, "element_id")
+
+
+def check_statepath(query_executor, analyzer: GenericAssistant,
+                    statepath) -> Tuple[str, Dict[str, List[str]]]:
+    """Audit every entity on a matched statepath record, then one summary
+    run producing the scored report.  Returns (report_text, path_clues)."""
+    timestamp = error_message = None
+    for ele in statepath:
+        if _is_node(ele) and ele["kind"] == "Event":
+            timestamp = ele["timestamp"]
+            error_message = ele["message"]
+    if timestamp is None:
+        raise ValueError("statepath record has no Event node")
+
+    path_clues: Dict[str, List[str]] = {}
+    kinds: List[str] = []
+    for ele in statepath:
+        if not _is_node(ele):
+            continue
+        if ele["kind2"] == "Event" or ele["kind"] == "Event":
+            continue
+        if ele["kind"] == "EVENT":
+            continue
+        entity_kind = entity.entity_kind(ele)
+        entity_id = ele["id"]
+        kinds.append(entity_kind)
+        clues = check_states_of_entity(entity_kind, entity_id, error_message,
+                                       timestamp, query_executor, analyzer)
+        path_clues[f"{entity_kind}({entity_id})"] = clues
+
+    prompt = (
+        f"Based on the previous analysis of {', '.join(kinds)}, summarize "
+        "the root cause of the error message and pinpoint the most relevant "
+        "parts.  For each kind give a relevance score (0-10).  Provide a "
+        "resolution with a kubectl or bash command where applicable, using "
+        "the actual resource names and namespaces for precision.  Include "
+        "crucial details (resource names, IDs, numbers).\n" + REPORT_SHAPE)
+    analyzer.add_message(prompt)
+    analyzer.run_assistant()
+    messages = analyzer.wait_get_last_k_message(1)
+    if messages is None:
+        raise RuntimeError(
+            f"analyzer run ended in state {analyzer.get_run_status().status}")
+    report = messages.data[0].content[0].text.value
+    return report, path_clues

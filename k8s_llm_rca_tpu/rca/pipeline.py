@@ -1,0 +1,180 @@
+"""Full RCA pipeline: stages 1-3 wired with the reference's failure policy.
+
+Mirrors the e2e drivers' control flow (test_all.py:18-161,
+test_with_file.py:20-229): srcKind -> destKind planning with <=3
+retry-with-feedback attempts (the exception text is appended to the thread)
+-> metapath ladder -> per-metapath cypher generation with <=3 retries ->
+deterministic compiler fallback on exhaustion OR zero records -> per-record
+statepath audit -> per-incident result dict with time_cost and windowed
+token usage (the exact batch-driver output schema,
+test_with_file.py:67-204).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from k8s_llm_rca_tpu.config import RCAConfig, SweepConfig
+from k8s_llm_rca_tpu.graph.executor import CypherSyntaxError
+from k8s_llm_rca_tpu.rca import auditor, cyphergen, locator
+from k8s_llm_rca_tpu.serve.api import AssistantService
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+IncidentResult = Dict[str, Any]
+
+
+@dataclass
+class RCAPipeline:
+    """Owns the three assistants + two graph executors for a sweep."""
+
+    service: AssistantService
+    meta_executor: Any
+    state_executor: Any
+    cfg: RCAConfig = field(default_factory=RCAConfig)
+    sweep: SweepConfig = field(default_factory=SweepConfig)
+
+    def __post_init__(self):
+        self.locator = locator.setup_root_cause_locator(
+            self.service, self.cfg.model)
+        self.native_kinds, self.external_kinds = \
+            locator.find_native_external_kinds(self.meta_executor)
+        self.prompt_template = locator.build_prompt_template(
+            self.native_kinds, self.external_kinds)
+        self.cypher_generator = cyphergen.setup_cypher_generator(
+            self.service, self.cfg.model)
+        self.analyzer = auditor.setup_state_semantic_analyzer(
+            self.service, self.cfg.model)
+
+    # ------------------------------------------------------------ stage 1
+
+    def plan_destination(self, error_message: str, src_kind: str
+                         ) -> (Dict[str, Any], int):
+        """destKind planning with retry-with-feedback (test_all.py:63-83)."""
+        last_err: Optional[Exception] = None
+        for attempt in range(self.cfg.locator_max_attempts):
+            try:
+                plan = locator.find_destKind_relevantResources(
+                    error_message, src_kind, self.prompt_template,
+                    self.locator)
+                return plan, attempt + 1
+            except json.JSONDecodeError as e:
+                log.warning("locator JSON error (attempt %d): %s", attempt, e)
+                self.locator.add_message(
+                    "The dest_relevant reply raised this exception:\n"
+                    f"JSON Error occurred: {e}\n"
+                    "Return the output as JSON inside a ```json fence.")
+                last_err = e
+            except Exception as e:
+                log.warning("locator error (attempt %d): %s", attempt, e)
+                self.locator.add_message(
+                    "The dest_relevant reply raised this exception:\n"
+                    f"An unexpected error occurred: {e}\n"
+                    "Based on the exception details above, generate a "
+                    "correct dest_relevant.")
+                last_err = e
+        raise RuntimeError(
+            f"destKind planning failed after "
+            f"{self.cfg.locator_max_attempts} attempts") from last_err
+
+    # ------------------------------------------------------------ stage 2
+
+    def compile_and_run(self, metapath_str: str, error_message: str,
+                        analysis: Dict[str, Any]) -> List[Any]:
+        """Cypher generation with retries + deterministic fallback
+        (test_all.py:99-131).  Mutates ``analysis`` with attempt metadata."""
+        records: List[Any] = []
+        cypher_query = None
+        generated_ok = False
+        attempt = 0
+        for attempt in range(self.cfg.cypher_max_attempts):
+            try:
+                cypher_query = cyphergen.generate_cypher_query(
+                    metapath_str, error_message, self.cypher_generator)
+                records = cyphergen.run_and_filter_query(
+                    self.state_executor, cypher_query)
+                generated_ok = True
+                break
+            except CypherSyntaxError as e:
+                log.warning("cypher syntax error (attempt %d): %s", attempt, e)
+                self.cypher_generator.add_message(
+                    "The previously generated cypher query raised:\n"
+                    f"Cypher Syntax Error occurred: {e}\n"
+                    "Generate a corrected version of the Cypher query.")
+            except Exception as e:
+                log.warning("cypher error (attempt %d): %s", attempt, e)
+                self.cypher_generator.add_message(
+                    "The previously generated cypher query raised:\n"
+                    f"An unexpected error occurred: {e}\n"
+                    "Generate a corrected version of the Cypher query.")
+        analysis["cypher_query"] = cypher_query
+        analysis["cypher_attempts"] = attempt + 1
+
+        # fall back when generation never succeeded, or succeeded but
+        # matched nothing (usually a semantic error in the query)
+        if not generated_ok or not records:
+            fallback = cyphergen.compile_metapath_query(
+                metapath_str, error_message)
+            records = cyphergen.run_and_filter_query(
+                self.state_executor, fallback)
+            analysis["human_cypher_query"] = fallback
+        return records
+
+    # ------------------------------------------------------------ pipeline
+
+    def analyze_incident(self, error_message: str) -> IncidentResult:
+        """One incident end-to-end; returns the batch-driver result dict
+        (schema of test_with_file.py:67-204)."""
+        t0 = time.time()
+        result: IncidentResult = {"error_message": error_message}
+        with METRICS.timer("rca.incident"):
+            src_kind = locator.find_srcKind(self.state_executor, error_message)
+            plan, attempts = self.plan_destination(error_message, src_kind)
+            result["locator_attempts"] = attempts
+
+            dest_kind = plan["DestinationKind"]
+            relevant = plan.get("RelevantResources", [])
+            known = set(self.native_kinds) | set(self.external_kinds)
+            intermediate = [x for x in relevant
+                            if x not in (src_kind, dest_kind) and x in known]
+
+            metapaths = locator.find_metapath(
+                self.meta_executor, src_kind, dest_kind, intermediate,
+                self.cfg.metapath_max_hops)
+
+            result["analysis"] = []
+            for metapath in metapaths:
+                metapath_str = cyphergen.extend_metapath_construct_string(
+                    metapath)
+                analysis: Dict[str, Any] = {"extend_metapath": metapath_str}
+                records = self.compile_and_run(metapath_str, error_message,
+                                               analysis)
+                analysis["statepath"] = []
+                for record in records:
+                    report, clues = auditor.check_statepath(
+                        self.state_executor, self.analyzer, record)
+                    analysis["statepath"].append(
+                        {"report": report, "clue": clues})
+                result["analysis"].append(analysis)
+
+        t1 = time.time()
+        result["time_cost"] = t1 - t0
+        result["token_usage"] = self.window_token_usage(int(t0), int(t1) + 1)
+        return result
+
+    def window_token_usage(self, tmin: int, tmax: int,
+                           sweep: Optional[SweepConfig] = None) -> Dict[str, int]:
+        """Aggregate usage across the three assistants in [tmin, tmax)
+        (limits mirror the reference's retry arithmetic,
+        test_with_file.py:177-198)."""
+        sweep = sweep or self.sweep
+        u1 = self.locator.get_token_usage(tmin, tmax, sweep.locator_usage_limit)
+        u2 = self.cypher_generator.get_token_usage(
+            tmin, tmax, sweep.cypher_usage_limit)
+        u3 = self.analyzer.get_token_usage(
+            tmin, tmax, sweep.analyzer_usage_limit)
+        return {k: u1[k] + u2[k] + u3[k] for k in u1}

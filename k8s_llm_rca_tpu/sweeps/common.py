@@ -1,0 +1,71 @@
+"""Shared driver wiring: backend/executor construction from config.
+
+Replaces the reference's copy-pasted hardcoded setup blocks (identical in
+all six drivers, e.g. test_all.py:18-37): backends and graph endpoints are
+chosen by config, and the hermetic in-memory backends are first-class.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Tuple
+
+from k8s_llm_rca_tpu.config import (
+    MODEL_REGISTRY, EngineConfig, RCAConfig, TINY,
+)
+from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+from k8s_llm_rca_tpu.graph.fixtures import build_metagraph, build_stategraph
+from k8s_llm_rca_tpu.rca.oracle import OracleBackend
+from k8s_llm_rca_tpu.serve.api import AssistantService
+from k8s_llm_rca_tpu.utils import get_tokenizer
+
+
+def add_common_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--backend", default="oracle",
+                        choices=["oracle", "engine"],
+                        help="LM backend: scripted oracle (hermetic) or the "
+                             "TPU inference engine")
+    parser.add_argument("--model", default="tiny",
+                        help=f"model preset for --backend engine: "
+                             f"{sorted(MODEL_REGISTRY)}")
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--max-seq-len", type=int, default=2048)
+    parser.add_argument("--neo4j-meta", default=None,
+                        help="bolt://host:port for a live metagraph "
+                             "(default: canned in-memory fixture)")
+    parser.add_argument("--neo4j-state", default=None,
+                        help="bolt://host:port for a live stategraph")
+    parser.add_argument("--neo4j-auth", default="neo4j:neo4j",
+                        help="user:password for live Neo4j")
+
+
+def build_service(args) -> AssistantService:
+    tokenizer = get_tokenizer()
+    if args.backend == "oracle":
+        return AssistantService(OracleBackend(tokenizer))
+    # engine backend: build the model + continuous-batching engine
+    import jax
+
+    from k8s_llm_rca_tpu.engine import InferenceEngine
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.serve.backend import EngineBackend
+
+    model_cfg = MODEL_REGISTRY.get(args.model, TINY)
+    params = llama.init_params(model_cfg, jax.random.PRNGKey(0))
+    engine = InferenceEngine(
+        model_cfg,
+        EngineConfig(max_batch=args.max_batch, max_seq_len=args.max_seq_len),
+        params, tokenizer)
+    return AssistantService(EngineBackend(engine))
+
+
+def build_executors(args) -> Tuple[object, object]:
+    if args.neo4j_meta or args.neo4j_state:
+        from k8s_llm_rca_tpu.graph.executor import Neo4jQueryExecutor
+
+        user, password = args.neo4j_auth.split(":", 1)
+        meta = Neo4jQueryExecutor(args.neo4j_meta, user, password)
+        state = Neo4jQueryExecutor(args.neo4j_state, user, password)
+        return meta, state
+    return (InMemoryGraphExecutor(build_metagraph()),
+            InMemoryGraphExecutor(build_stategraph()))

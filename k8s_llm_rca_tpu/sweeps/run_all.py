@@ -1,0 +1,60 @@
+"""Interactive end-to-end driver (the reference's test_all.py equivalent).
+
+Runs the full pipeline over a slice of the built-in incident corpus and
+prints the reports plus wall-clock bracketing (reference :52,143-151).
+
+Usage:
+    python -m k8s_llm_rca_tpu.sweeps.run_all [--backend oracle|engine]
+        [--slice 0:4] [--model tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from k8s_llm_rca_tpu.config import RCAConfig
+from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS
+from k8s_llm_rca_tpu.rca import RCAPipeline
+from k8s_llm_rca_tpu.sweeps.common import (
+    add_common_args, build_executors, build_service,
+)
+from k8s_llm_rca_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_common_args(parser)
+    parser.add_argument("--slice", default="0:4",
+                        help="incident corpus slice, python syntax lo:hi")
+    args = parser.parse_args(argv)
+
+    lo, hi = (int(x) if x else None for x in args.slice.split(":"))
+    messages = [i.message for i in INCIDENTS[lo:hi]]
+
+    service = build_service(args)
+    meta, state = build_executors(args)
+    pipeline = RCAPipeline(service, meta, state,
+                           RCAConfig(model=args.model))
+
+    start = time.time()
+    for message in messages:
+        print("=" * 100)
+        print(message)
+        result = pipeline.analyze_incident(message)
+        for analysis in result["analysis"]:
+            for sp in analysis["statepath"]:
+                print("-" * 100)
+                print(sp["report"])
+    elapsed = time.time() - start
+    print("*" * 100)
+    print(f"analyzed {len(messages)} incident(s) in {elapsed:.2f}s "
+          f"({elapsed / max(len(messages), 1):.2f}s per incident)")
+    meta.close()
+    state.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Metered batch driver (the reference's test_with_file.py equivalent).
+
+Reads incidents from a CSV (one message per row, header skipped), runs the
+full pipeline, and APPENDS one JSON record per incident to the output file —
+the sweep is resumable at incident granularity, exactly like the reference
+(test_with_file.py:42-53,200-204).  Each record carries the reference's
+schema: error_message, locator_attempts, analysis[{extend_metapath,
+cypher_query, cypher_attempts, human_cypher_query?, statepath[{report,
+clue}]}], time_cost, token_usage.
+
+Usage:
+    python -m k8s_llm_rca_tpu.sweeps.run_file --input data/incidents.csv \
+        --output output/rca-results.json [--backend oracle|engine]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import time
+
+from k8s_llm_rca_tpu.config import RCAConfig, SweepConfig
+from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS
+from k8s_llm_rca_tpu.rca import RCAPipeline
+from k8s_llm_rca_tpu.sweeps.common import (
+    add_common_args, build_executors, build_service,
+)
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+
+def write_default_corpus(path: str, repeat: int = 1) -> None:
+    """Materialize the built-in incident corpus as a driver CSV."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["error_message"])
+        for _ in range(repeat):
+            for incident in INCIDENTS:
+                writer.writerow([incident.message])
+
+
+def load_corpus(path: str) -> list:
+    messages = []
+    with open(path, newline="") as f:
+        reader = csv.reader(f)
+        next(reader)                      # header
+        for row in reader:
+            if row:
+                messages.append(row[0])
+    return messages
+
+
+def completed_incidents(output_path: str) -> int:
+    """Resumability: count already-written records (the file is a stream of
+    concatenated pretty-printed JSON objects, reference format)."""
+    if not os.path.exists(output_path):
+        return 0
+    with open(output_path) as f:
+        text = f.read()
+    decoder = json.JSONDecoder()
+    idx, count = 0, 0
+    while idx < len(text):
+        while idx < len(text) and text[idx].isspace():
+            idx += 1
+        if idx >= len(text):
+            break
+        try:
+            _, idx = decoder.raw_decode(text, idx)
+        except ValueError:
+            break                         # trailing partial record
+        count += 1
+    return count
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_common_args(parser)
+    parser.add_argument("--input", default="data/incidents.csv")
+    parser.add_argument("--output", default="output/rca-results.json")
+    parser.add_argument("--slice", default=":",
+                        help="incident slice lo:hi")
+    parser.add_argument("--resume", action="store_true",
+                        help="skip incidents already present in --output")
+    args = parser.parse_args(argv)
+
+    if not os.path.exists(args.input):
+        log.info("input %s missing; writing the built-in corpus", args.input)
+        write_default_corpus(args.input)
+
+    messages = load_corpus(args.input)
+    lo, hi = (int(x) if x else None for x in args.slice.split(":"))
+    messages = messages[lo:hi]
+    skip = completed_incidents(args.output) if args.resume else 0
+    if skip:
+        log.info("resuming: %d incidents already in %s", skip, args.output)
+        messages = messages[skip:]
+
+    service = build_service(args)
+    meta, state = build_executors(args)
+    pipeline = RCAPipeline(
+        service, meta, state, RCAConfig(model=args.model),
+        sweep=SweepConfig(input_csv=args.input, output_json=args.output))
+
+    os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+    start = time.time()
+    costs = []
+    failures = 0
+    for message in messages:
+        t0 = time.time()
+        try:
+            result = pipeline.analyze_incident(message)
+        except Exception as e:          # a failed incident must not kill the
+            failures += 1               # sweep; the record keeps it resumable
+            log.warning("incident failed: %s", e)
+            result = {"error_message": message, "error": str(e),
+                      "time_cost": time.time() - t0}
+        costs.append(result["time_cost"])
+        with open(args.output, "a") as f:
+            f.write(json.dumps(result, indent=4) + "\n")
+        log.info("incident done in %.2fs -> %s", result["time_cost"],
+                 args.output)
+    elapsed = time.time() - start
+
+    summary = {
+        "incidents": len(messages),
+        "failures": failures,
+        "wall_s": elapsed,
+        "p50_incident_s": sorted(costs)[len(costs) // 2] if costs else 0.0,
+        "metrics": METRICS.snapshot(),
+    }
+    print(json.dumps({k: v for k, v in summary.items() if k != "metrics"}))
+    meta.close()
+    state.close()
+    return summary
+
+
+if __name__ == "__main__":
+    main()
