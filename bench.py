@@ -40,8 +40,9 @@ def pick_config():
     dev = jax.devices()[0]
     if dev.platform != "tpu":
         return TINY.replace(name="bench-tiny"), 8, 64, 128
-    # one v5e chip (16G HBM): TinyLlama-1.1B bf16 ~2.2G weights + KV headroom
-    cfg = MODEL_REGISTRY["tinyllama-1.1b"].replace(max_seq_len=1024)
+    # one v5e chip (16G HBM): TinyLlama-1.1B bf16 ~2.2G weights + KV headroom.
+    # max_seq must hold prompt + warmup scan + measured scan (128 + 2*512).
+    cfg = MODEL_REGISTRY["tinyllama-1.1b"].replace(max_seq_len=2048)
     return cfg, 8, 128, 512
 
 
@@ -75,9 +76,9 @@ def bench_decode(cfg, batch, prompt_len, decode_steps):
     # outputs (fresh cache/tokens/key).  The chain defeats the axon tunnel's
     # memoization of identical executions, and a long scan amortizes
     # dispatch overhead so the number reflects steady-state decode.
-    c2, toks, _ = scan(cfg, params, cache, cur, lengths,
-                       jax.random.PRNGKey(0), decode_steps,
-                       SamplingParams(), tok.eos_id)
+    c2, toks, lengths = scan(cfg, params, cache, cur, lengths,
+                             jax.random.PRNGKey(0), decode_steps,
+                             SamplingParams(), tok.eos_id)
     toks.block_until_ready()
     start = time.perf_counter()
     c2, toks, _ = scan(cfg, params, c2, toks[-1], lengths,

@@ -1,0 +1,106 @@
+"""Ring attention: context parallelism over the ``seq`` mesh axis.
+
+The long-context mode SURVEY §2.2 calls for: activations are sharded along
+the sequence; each device keeps its query block resident while KV blocks
+rotate around the ICI ring via ``jax.lax.ppermute``, with flash-style
+online-softmax accumulation so the full [S, S] score matrix never
+materializes.  Causality is enforced at block granularity (a KV block
+entirely in the future is skipped via masking) and elementwise inside the
+diagonal block.
+
+This is the CP prefill path for RCA prompts that exceed one device's cache
+(the reference's threads grow monotonically — SURVEY §5 long-context note).
+Pure-XLA implementation (collectives + einsums); the Pallas fused variant
+can swap in per-step later without changing the calling convention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_llm_rca_tpu.ops.attention import NEG_INF
+
+
+def _block_attention_step(q, k, v, q_pos, k_pos, acc, m, l):
+    """One online-softmax accumulation step.
+
+    q [B,Sq,H,D]; k/v [B,Skv,Kv,D] — kv heads stay UNEXPANDED (grouped
+    einsums handle GQA) so the ring carries 1/n_rep of the bytes per
+    ppermute; q_pos [Sq]; k_pos [Skv]; acc [B,Sq,H,D] fp32; m,l [B,Sq,H]
+    fp32 running max / denominator.
+    """
+    b, sq, n_heads, d = q.shape
+    n_kv = k.shape[2]
+    n_rep = n_heads // n_kv
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qg = q.astype(jnp.float32).reshape(b, sq, n_kv, n_rep, d)
+    scores = jnp.einsum("bqgrd,bkgd->bqgrk", qg,
+                        k.astype(jnp.float32)).reshape(
+                            b, sq, n_heads, -1) * scale         # [B,Sq,H,Skv]
+    causal = q_pos[:, None] >= k_pos[None, :]                   # [Sq,Skv]
+    scores = jnp.where(causal[None, :, None, :], scores, NEG_INF)
+
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))            # [B,Sq,H]
+    # guard fully-masked rows (m_new == NEG_INF): keep them at zero weight
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(causal[None, :, None, :], p, 0.0)
+    correction = jnp.where(m <= NEG_INF / 2, 0.0,
+                           jnp.exp(m - m_safe))
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pg = p.reshape(b, sq, n_kv, n_rep, -1)
+    upd = jnp.einsum("bqgrk,bkgd->bqgrd", pg,
+                     v.astype(jnp.float32)).reshape(b, sq, n_heads, d)
+    acc_new = acc * correction[..., None] + upd
+    return acc_new, m_new, l_new
+
+
+def _ring_attention_local(q, k, v, axis_name: str):
+    """Per-shard body under shard_map: q/k/v [B, S_local, h, d]."""
+    n_dev = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_local, n_heads, d = q.shape
+
+    q_pos = my * s_local + jnp.arange(s_local)
+    acc = jnp.zeros((b, s_local, n_heads, d), jnp.float32)
+    m = jnp.full((b, s_local, n_heads), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, s_local, n_heads), jnp.float32)
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def step(s, carry):
+        k_cur, v_cur, acc, m, l = carry
+        src = (my - s) % n_dev                 # owner of the block we hold
+        k_pos = src * s_local + jnp.arange(s_local)
+        acc, m, l = _block_attention_step(q, k_cur, v_cur, q_pos, k_pos,
+                                          acc, m, l)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc, m, l)
+
+    carry = (k, v, acc, m, l)
+    for s in range(n_dev):                     # static unroll over ring steps
+        carry = step(s, carry)
+    _, _, acc, m, l = carry
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, seq_axis: str = "seq") -> jnp.ndarray:
+    """Causal self-attention with sequence sharded over ``seq_axis``.
+
+    q [B, S, n_heads, d], k/v [B, S, n_kv, d] (global views).  S must be
+    divisible by the axis size.  Returns [B, S, n_heads, d].
+    """
+    body = functools.partial(_ring_attention_local, axis_name=seq_axis)
+    spec = P(None, seq_axis, None, None)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

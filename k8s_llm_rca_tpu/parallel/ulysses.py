@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence reshard.
+
+The alternative long-context mode (SURVEY §2.2): activations arrive
+sequence-sharded; an all-to-all over the ``seq`` axis re-shards them to
+head-sharded with the FULL sequence per device, plain causal attention runs
+locally (each device owns n_heads/P heads), and a second all-to-all restores
+sequence sharding.  Two collectives per attention instead of ring's P
+ppermute steps — better when n_heads >= axis size and the full sequence fits
+one device's memory.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_llm_rca_tpu.ops.attention import causal_attention, repeat_kv
+
+
+def _ulysses_local(q, k, v, axis_name: str):
+    """Under shard_map: q/k/v [B, S/P, H, D] -> out [B, S/P, H, D].
+
+    KV heads stay unexpanded through the all-to-all when they divide the
+    axis size (the per-device q-head block [d*H/P, (d+1)*H/P) maps exactly
+    onto kv-head block [d*Kv/P, (d+1)*Kv/P) under blockwise GQA grouping),
+    saving n_rep x collective volume; otherwise expand first.
+    """
+    n_dev = jax.lax.axis_size(axis_name)
+    if k.shape[2] % n_dev != 0:
+        n_rep = q.shape[2] // k.shape[2]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+
+    # [B, S/P, H, D] -> all_to_all: split heads (axis 2), concat seq (axis 1)
+    def to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [B, S, H/P, D]
+    b, s, _, _ = qh.shape
+    seq_lens = jnp.full((b,), s, jnp.int32)
+    out = causal_attention(qh, kh, vh, seq_lens)         # repeats kv inside
+    return to_seq(out)                                   # [B, S/P, H, D]
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, seq_axis: str = "seq") -> jnp.ndarray:
+    """Causal attention with sequence sharded over ``seq_axis`` via
+    head<->sequence all-to-all.  n_heads must be divisible by the axis size
+    (GQA kv heads are expanded first)."""
+    axis = mesh.shape[seq_axis]
+    if q.shape[2] % axis:
+        raise ValueError(
+            f"n_heads {q.shape[2]} not divisible by {seq_axis}={axis}")
+    body = functools.partial(_ulysses_local, axis_name=seq_axis)
+    spec = P(None, seq_axis, None, None)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -179,8 +179,11 @@ def check_states_of_entity(entity_kind: str, entity_id: str,
 def check_states_existence_and_semantic(query_executor, cypher_query: str,
                                         analyzer: GenericAssistant,
                                         error_message: str) -> List[str]:
-    """Legacy single-query variant kept for stage-isolated harnesses
-    (reference :155-170, still used by test_check_state.py:48)."""
+    """Single-query variant for stage-isolated harnesses: the caller builds
+    the state query itself (strict or loose) and passes it in, as the
+    reference's stage-3 harness does (reference :155-170; its
+    test_check_state.py:48 calls this with a pinned query).  Exercised here
+    by tests/test_auditor_stage.py."""
     clues: List[str] = []
     records = query_executor.run_query(cypher_query)
     if not records:
