@@ -69,7 +69,111 @@ class _Pending:
     stop_strings: Tuple[str, ...]
 
 
-class InferenceEngine:
+class EngineBase:
+    """Shared continuous-batching engine surface.
+
+    Subclasses (contiguous InferenceEngine, paged.PagedInferenceEngine)
+    implement ``step()`` and their own slot/cache bookkeeping; everything
+    the agent layer sees — submit/generate semantics, prompt clamping,
+    finish reasons, stop-string trimming — lives here so the two cache
+    designs can't drift apart.
+    """
+
+    model_cfg: ModelConfig
+    engine_cfg: EngineConfig
+    tokenizer: Tokenizer
+
+    # -------------------------------------------------------- shared api
+
+    def _clamp_prompt(self, prompt_ids: Sequence[int],
+                      max_new_tokens: Optional[int]) -> Tuple[List[int], int]:
+        """Fit prompt + generation into the per-sequence cache budget.
+
+        First shrink max_new to what the cache can hold after the prompt;
+        if the prompt alone overflows, keep its TAIL (the task statement
+        sits at the end of RCA prompts) while reserving at least cap//4
+        tokens of generation room.  (Long-context CP/ring-attention
+        prefill lifts this limit later.)
+        """
+        max_new = (self.engine_cfg.max_new_tokens
+                   if max_new_tokens is None else max_new_tokens)
+        prompt_ids = list(prompt_ids)
+        cap = self.engine_cfg.max_seq_len
+        if len(prompt_ids) + max_new + 1 > cap:
+            reserve = min(max_new, max(1, cap // 4))
+            budget = cap - reserve - 1
+            if len(prompt_ids) > budget:
+                log.warning(
+                    "truncating prompt %d -> %d tokens (cache cap %d)",
+                    len(prompt_ids), budget, cap)
+                had_bos = prompt_ids[0] == self.tokenizer.bos_id
+                prompt_ids = prompt_ids[-budget:]
+                if had_bos:   # keep BOS conditioning after tail-truncation
+                    prompt_ids[0] = self.tokenizer.bos_id
+            max_new = min(max_new, cap - len(prompt_ids) - 1)
+        return prompt_ids, max_new
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active or self._pending)
+
+    def step(self) -> List[SequenceResult]:
+        raise NotImplementedError
+
+    def run_to_completion(self) -> List[SequenceResult]:
+        """Pump until queue and slots drain; returns all finished sequences."""
+        out: List[SequenceResult] = []
+        while self.has_work:
+            out.extend(self.step())
+        return out
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+        stop_strings: Sequence[str] = (),
+    ) -> List[SequenceResult]:
+        """Batch convenience: submit all, pump, return in submit order."""
+        ids = [self.submit(p, max_new_tokens, stop_strings) for p in prompts]
+        results = {r.seq_id: r for r in self.run_to_completion()}
+        return [results[i] for i in ids]
+
+    # ------------------------------------------------- shared termination
+
+    def _finish_reason(self, st: _Active, token: int,
+                       length: int) -> Optional[str]:
+        if token == self.tokenizer.eos_id:
+            return "eos"
+        if len(st.generated) >= st.max_new_tokens:
+            return "length"
+        if length + 1 >= self.engine_cfg.max_seq_len:
+            return "length"
+        if st.stop_strings:
+            # decode only a bounded tail window: a token covers >= 1 char,
+            # so a window of max_stop_chars + 8 tokens always contains any
+            # stop string that just completed (avoids O(n^2) re-decoding).
+            window = max(len(s) for s in st.stop_strings) + 8
+            text = self.tokenizer.decode(st.generated[-window:])
+            for s in st.stop_strings:
+                if s in text:
+                    return "stop"
+        return None
+
+    def _final_text(self, generated: List[int], reason: str,
+                    stop_strings: Tuple[str, ...]) -> str:
+        text = self.tokenizer.decode(generated)
+        if reason == "eos":
+            text = self.tokenizer.decode(generated[:-1])
+        elif reason == "stop":
+            for s in stop_strings:
+                idx = text.find(s)
+                if idx >= 0:
+                    text = text[:idx]
+                    break
+        return text
+
+
+class InferenceEngine(EngineBase):
     """Single-host engine over one model replica (sharded or not)."""
 
     def __init__(
@@ -119,34 +223,10 @@ class InferenceEngine:
     ) -> int:
         """Queue a sequence; returns its seq_id.  Non-blocking."""
         seq_id = next(self._seq_counter)
-        max_new = (self.engine_cfg.max_new_tokens
-                   if max_new_tokens is None else max_new_tokens)
-        prompt_ids = list(prompt_ids)
-        cap = self.engine_cfg.max_seq_len
-        # Fit prompt + generation into the slot: first shrink max_new to what
-        # the cache can hold after the prompt; if the prompt alone overflows,
-        # keep its TAIL (the task statement sits at the end of RCA prompts)
-        # while reserving at least cap//4 tokens of generation room.
-        # (Long-context CP/ring-attention prefill lifts this limit later.)
-        if len(prompt_ids) + max_new + 1 > cap:
-            reserve = min(max_new, max(1, cap // 4))
-            budget = cap - reserve - 1
-            if len(prompt_ids) > budget:
-                log.warning(
-                    "truncating prompt %d -> %d tokens (cache cap %d)",
-                    len(prompt_ids), budget, cap)
-                had_bos = prompt_ids[0] == self.tokenizer.bos_id
-                prompt_ids = prompt_ids[-budget:]
-                if had_bos:   # keep BOS conditioning after tail-truncation
-                    prompt_ids[0] = self.tokenizer.bos_id
-            max_new = min(max_new, cap - len(prompt_ids) - 1)
+        prompt_ids, max_new = self._clamp_prompt(prompt_ids, max_new_tokens)
         self._pending.append(
             _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings)))
         return seq_id
-
-    @property
-    def has_work(self) -> bool:
-        return bool(self._active or self._pending)
 
     def step(self) -> List[SequenceResult]:
         """One engine tick: admit pending into free slots, then one decode
@@ -181,24 +261,6 @@ class InferenceEngine:
             if reason is not None:
                 finished.append(self._retire(slot, reason))
         return finished
-
-    def run_to_completion(self) -> List[SequenceResult]:
-        """Pump until queue and slots drain; returns all finished sequences."""
-        out: List[SequenceResult] = []
-        while self.has_work:
-            out.extend(self.step())
-        return out
-
-    def generate(
-        self,
-        prompts: Sequence[Sequence[int]],
-        max_new_tokens: Optional[int] = None,
-        stop_strings: Sequence[str] = (),
-    ) -> List[SequenceResult]:
-        """Batch convenience: submit all, pump, return in submit order."""
-        ids = [self.submit(p, max_new_tokens, stop_strings) for p in prompts]
-        results = {r.seq_id: r for r in self.run_to_completion()}
-        return [results[i] for i in ids]
 
     # ------------------------------------------------------------- internals
 
@@ -237,36 +299,10 @@ class InferenceEngine:
             return self._retire(slot, reason)
         return None
 
-    def _finish_reason(self, st: _Active, token: int, length: int) -> Optional[str]:
-        if token == self.tokenizer.eos_id:
-            return "eos"
-        if len(st.generated) >= st.max_new_tokens:
-            return "length"
-        if length + 1 >= self.engine_cfg.max_seq_len:
-            return "length"
-        if st.stop_strings:
-            # decode only a bounded tail window: a token covers >= 1 char, so
-            # a window of max_stop_chars + 8 tokens always contains any stop
-            # string that just completed (avoids O(n^2) re-decoding).
-            window = max(len(s) for s in st.stop_strings) + 8
-            text = self.tokenizer.decode(st.generated[-window:])
-            for s in st.stop_strings:
-                if s in text:
-                    return "stop"
-        return None
-
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)
         self._free_slots.append(slot)
-        text = self.tokenizer.decode(st.generated)
-        if reason == "eos":
-            text = self.tokenizer.decode(st.generated[:-1])
-        elif reason == "stop":
-            for s in st.stop_strings:
-                idx = text.find(s)
-                if idx >= 0:
-                    text = text[:idx]
-                    break
+        text = self._final_text(st.generated, reason, st.stop_strings)
         return SequenceResult(
             seq_id=st.seq_id,
             token_ids=list(st.generated),

@@ -1,0 +1,456 @@
+"""Paged KV cache: block allocator, paged model entry points, engine.
+
+vLLM-style paging re-designed for XLA's static shapes:
+
+- the KV pool is one [L, n_kv, n_pages, page_size, d] array per k/v —
+  every shape static, so prefill/decode compile once;
+- **page 0 is the reserved trash page**: block-table entries past a
+  sequence's live pages point at it, so scatter/gather indices are
+  always in-bounds (JAX clamps out-of-bounds anyway, but clamping would
+  silently corrupt the *last* page — the trash page makes over-writes
+  harmless by construction) and the paged-attention kernel masks it out
+  by length;
+- the allocator is host-side and is the single owner of page ids.  It
+  enforces the invariants SURVEY §5 (race detection) demands of the
+  build: no double-free, no page owned by two sequences, exact leak
+  accounting.  (The reference has no cache and no concurrency at all —
+  its serving state lives behind the OpenAI API, reference
+  common/openai_generic_assistant.py:45-51.)
+
+Attention during decode runs through the Pallas paged-attention kernel
+on TPU (ops/paged_attention.py) and its XLA reference path elsewhere.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
+from k8s_llm_rca_tpu.engine.engine import (
+    EngineBase, SequenceResult, _Active, _Pending,
+)
+from k8s_llm_rca_tpu.engine.sampling import SamplingParams, sample_tokens
+from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.ops.norms import rms_norm
+from k8s_llm_rca_tpu.ops.paged_attention import (
+    paged_attention, paged_attention_xla,
+)
+from k8s_llm_rca_tpu.ops.rope import rope_frequencies
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
+
+log = get_logger(__name__)
+
+TRASH_PAGE = 0
+
+
+class AllocatorError(RuntimeError):
+    """Invariant violation (double free, alias, foreign page)."""
+
+
+class OutOfPages(RuntimeError):
+    """Pool exhausted; caller should preempt a sequence and retry."""
+
+
+class PageAllocator:
+    """Host-side free-list allocator over page ids 1..n_pages-1.
+
+    Page 0 is never handed out (trash page, see module docstring).
+    Every page is owned by at most one owner tag; `free` verifies
+    ownership so a double-free or cross-sequence free fails loudly
+    instead of silently aliasing KV state.
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.n_pages = n_pages
+        self._free: List[int] = list(range(1, n_pages))
+        self._owner: Dict[int, int] = {}          # page -> owner tag
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def pages_of(self, owner: int) -> List[int]:
+        return [p for p, o in self._owner.items() if o == owner]
+
+    def alloc(self, n: int, owner: int) -> List[int]:
+        if n > len(self._free):
+            raise OutOfPages(
+                f"need {n} pages, {len(self._free)} free of {self.n_pages}")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        return pages
+
+    def free(self, pages: Sequence[int], owner: int) -> None:
+        for p in pages:
+            if p == TRASH_PAGE:
+                raise AllocatorError("attempt to free the trash page")
+            got = self._owner.get(p)
+            if got is None:
+                raise AllocatorError(f"double free of page {p}")
+            if got != owner:
+                raise AllocatorError(
+                    f"page {p} owned by {got}, freed by {owner}")
+            del self._owner[p]
+            self._free.append(p)
+
+    def check(self) -> None:
+        """Global invariant: free ∪ owned == all pages, disjoint."""
+        free: Set[int] = set(self._free)
+        owned: Set[int] = set(self._owner)
+        if free & owned:
+            raise AllocatorError(f"pages both free and owned: {free & owned}")
+        if len(free) != len(self._free):
+            raise AllocatorError("duplicate entries in free list")
+        universe = set(range(1, self.n_pages))
+        if free | owned != universe:
+            raise AllocatorError(
+                f"leaked pages: {sorted(universe - free - owned)}")
+
+
+# ---------------------------------------------------------------------------
+# paged model entry points
+# ---------------------------------------------------------------------------
+
+
+def init_paged_cache(cfg: ModelConfig, n_pages: int, page_size: int):
+    shape = (cfg.n_layers, cfg.n_kv_heads, n_pages, page_size, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
+                  tokens: jnp.ndarray, length: jnp.ndarray,
+                  page_map: jnp.ndarray):
+    """Prefill ONE sequence, scattering its KV into ``page_map`` pages.
+
+    tokens [1, S_pad] with S_pad a multiple of page_size; page_map
+    [S_pad // page_size] int32 page ids (entries past the prompt's pages
+    must be TRASH_PAGE).  Returns (k_pages', v_pages', logits [1, V]).
+    """
+    _, s_pad = tokens.shape
+    page_size = k_pages.shape[3]
+    assert s_pad % page_size == 0, (s_pad, page_size)
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = jnp.arange(s_pad)[None, :]
+    seq_lens = jnp.asarray(length).reshape(1)
+    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
+
+    ks, vs = [], []
+    for layer in params["layers"]:
+        x, k, v = llama._block_prefill(cfg, layer, x, angles, positions,
+                                       seq_lens)
+        ks.append(k[0])                       # [S_pad, n_kv, d]
+        vs.append(v[0])
+    new_k = jnp.stack(ks)                     # [L, S_pad, n_kv, d]
+    new_v = jnp.stack(vs)
+
+    n_seq_pages = s_pad // page_size
+    # [L, S_pad, n_kv, d] -> [L, n_kv, n_seq_pages, page_size, d]
+    def to_pages(a):
+        L = a.shape[0]
+        a = a.reshape(L, n_seq_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        return a.transpose(0, 3, 1, 2, 4)
+
+    k_pages = k_pages.at[:, :, page_map].set(to_pages(new_k))
+    v_pages = v_pages.at[:, :, page_map].set(to_pages(new_v))
+
+    last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+    logits = llama._logits(cfg, params, last)[:, 0]
+    return k_pages, v_pages, logits
+
+
+def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
+                      tokens: jnp.ndarray, lengths: jnp.ndarray,
+                      block_tables: jnp.ndarray, *,
+                      use_kernel: Optional[bool] = None):
+    """One decode step for all sequences over the paged pool.
+
+    tokens [B]; lengths [B] tokens already cached; block_tables
+    [B, pages_per_seq].  The new token's KV is written at logical
+    position lengths[b], i.e. page block_tables[b, lengths[b] // page]
+    offset lengths[b] % page.  Returns (k_pages', v_pages', logits).
+    """
+    b = tokens.shape[0]
+    page_size = k_pages.shape[3]
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = lengths[:, None]
+    x = params["embedding"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
+
+    page_idx = lengths // page_size
+    page_ids = jnp.take_along_axis(
+        block_tables, page_idx[:, None], axis=1)[:, 0]        # [B]
+    offsets = lengths % page_size                             # [B]
+
+    attn_fn = paged_attention if use_kernel or (
+        use_kernel is None and jax.default_backend() == "tpu"
+    ) else paged_attention_xla
+
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._qkv(cfg, layer, h, angles, positions)  # [B,1,·,d]
+        # scatter this token's k/v: [B, n_kv, d] -> pool[li, :, page, off]
+        kp = k_pages[li].at[:, page_ids, offsets].set(
+            k[:, 0].transpose(1, 0, 2))
+        vp = v_pages[li].at[:, page_ids, offsets].set(
+            v[:, 0].transpose(1, 0, 2))
+        k_pages = k_pages.at[li].set(kp)
+        v_pages = v_pages.at[li].set(vp)
+        attn = attn_fn(q[:, 0], kp, vp, lengths + 1, block_tables)
+        x = x + attn.reshape(b, 1, cfg.q_dim) @ layer["wo"]
+        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(cfg, layer, hm)
+
+    logits = llama._logits(cfg, params, x)[:, 0]
+    return k_pages, v_pages, logits
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class PagedInferenceEngine(EngineBase):
+    """Continuous batching over the paged pool with on-demand page growth
+    and preemption.
+
+    Differences from engine.InferenceEngine (contiguous):
+    - pages are allocated per sequence: ceil(prompt/page) at admission,
+      +1 page whenever decode crosses a page boundary;
+    - if the pool is exhausted, the **youngest** active sequence is
+      preempted: its pages are freed and it is requeued with
+      prompt+generated as the new prompt (SURVEY §5 failure-recovery:
+      engine-level preemption/requeue);
+    - block tables live on the host (numpy) and ship to the device as a
+      [B, pages_per_seq] int32 each tick (tiny).
+    """
+
+    def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
+                 params, tokenizer: Tokenizer,
+                 use_kernel: Optional[bool] = None):
+        self.model_cfg = model_cfg
+        self.engine_cfg = engine_cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.use_kernel = use_kernel
+        self.sampling = SamplingParams(
+            temperature=engine_cfg.temperature,
+            top_k=engine_cfg.top_k,
+            top_p=engine_cfg.top_p,
+        )
+
+        b = engine_cfg.max_batch
+        self.page_size = engine_cfg.page_size
+        self.pages_per_seq = -(-engine_cfg.max_seq_len // self.page_size)
+        if engine_cfg.num_pages - 1 < self.pages_per_seq:
+            # guarantees any single sequence is admittable once the pool is
+            # drained, so preemption always makes progress
+            raise ValueError(
+                f"num_pages={engine_cfg.num_pages} cannot hold one full "
+                f"sequence ({self.pages_per_seq} pages + trash page)")
+        self.k_pages, self.v_pages = init_paged_cache(
+            model_cfg, engine_cfg.num_pages, self.page_size)
+        self.allocator = PageAllocator(engine_cfg.num_pages)
+
+        self.block_tables = np.full((b, self.pages_per_seq), TRASH_PAGE,
+                                    np.int32)
+        self.lengths = np.zeros((b,), np.int64)
+        self.cur_tokens = np.zeros((b,), np.int64)
+        self._key = jax.random.PRNGKey(engine_cfg.seed)
+
+        self._free_slots = list(range(b))
+        self._active: Dict[int, _Active] = {}
+        self._pending: List[_Pending] = []
+        self._seq_counter = itertools.count()
+        self._prompts: Dict[int, List[int]] = {}   # seq_id -> ORIGINAL prompt
+        self._resumed: Dict[int, List[int]] = {}   # seq_id -> pre-preemption
+                                                   #           generated tokens
+
+        self._prefill = jax.jit(paged_prefill, static_argnums=0)
+        self._decode = jax.jit(
+            paged_decode_step, static_argnums=(0,),
+            static_argnames=("use_kernel",))
+        self._sample = jax.jit(sample_tokens, static_argnums=2)
+
+        self._buckets = tuple(
+            s for s in sorted(set(engine_cfg.prefill_buckets))
+            if s <= engine_cfg.max_seq_len) or (engine_cfg.max_seq_len,)
+
+    # ------------------------------------------------------------------ api
+
+    def submit(self, prompt_ids: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               stop_strings: Sequence[str] = ()) -> int:
+        seq_id = next(self._seq_counter)
+        prompt_ids, max_new = self._clamp_prompt(prompt_ids, max_new_tokens)
+        self._prompts[seq_id] = list(prompt_ids)
+        self._pending.append(
+            _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings)))
+        return seq_id
+
+    def step(self) -> List[SequenceResult]:
+        finished: List[SequenceResult] = []
+        while self._pending and self._free_slots:
+            pend = self._pending[0]
+            try:
+                early = self._admit(pend)
+            except OutOfPages:
+                if not self._preempt_youngest():
+                    break                       # nothing to evict; wait
+                continue
+            self._pending.pop(0)
+            if early is not None:
+                finished.append(early)
+        if not self._active:
+            return finished
+
+        # grow block tables for sequences about to cross a page boundary
+        for slot in sorted(self._active):
+            if slot not in self._active:
+                # a previous iteration's _preempt_youngest() evicted it
+                continue
+            if self.lengths[slot] % self.page_size == 0:
+                try:
+                    self._grow(slot)
+                except OutOfPages:
+                    if not self._preempt_youngest(exclude=slot):
+                        # evict this one instead (it cannot take a step)
+                        self._preempt_slot(slot)
+                    else:
+                        self._grow(slot)
+        active_slots = sorted(self._active)
+        if not active_slots:
+            return finished
+
+        with METRICS.timer("engine.decode_step"):
+            self.k_pages, self.v_pages, logits = self._decode(
+                self.model_cfg, self.params, self.k_pages, self.v_pages,
+                jnp.asarray(self.cur_tokens, jnp.int32),
+                jnp.asarray(self.lengths, jnp.int32),
+                jnp.asarray(self.block_tables),
+                use_kernel=self.use_kernel)
+            self._key, sub = jax.random.split(self._key)
+            next_tokens = self._sample(logits, sub, self.sampling)
+        METRICS.inc("engine.decode_tokens", len(active_slots))
+
+        host_next = np.asarray(next_tokens)
+        for slot in active_slots:
+            self.lengths[slot] += 1
+            st = self._active[slot]
+            token = int(host_next[slot])
+            self.cur_tokens[slot] = token
+            st.generated.append(token)
+            reason = self._finish_reason(st, token, int(self.lengths[slot]))
+            if reason is not None:
+                finished.append(self._retire(slot, reason))
+        return finished
+
+    # ------------------------------------------------------------- internals
+
+    def _bucket(self, n: int) -> int:
+        # bucket to a page multiple so prefill scatters whole pages
+        for b in self._buckets:
+            if n <= b:
+                return -(-b // self.page_size) * self.page_size
+        return self.pages_per_seq * self.page_size
+
+    def _admit(self, req: _Pending) -> Optional[SequenceResult]:
+        n = len(req.prompt_ids)
+        bucket = self._bucket(n)
+        n_pages = bucket // self.page_size
+        pages = self.allocator.alloc(n_pages, owner=req.seq_id)  # OutOfPages?
+        slot = self._free_slots.pop(0)
+
+        table = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
+        table[:n_pages] = pages
+        self.block_tables[slot] = table
+
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req.prompt_ids
+        with METRICS.timer("engine.prefill"):
+            self.k_pages, self.v_pages, logits = self._prefill(
+                self.model_cfg, self.params, self.k_pages, self.v_pages,
+                jnp.asarray(padded), jnp.int32(n), jnp.asarray(table[:n_pages]))
+            self._key, sub = jax.random.split(self._key)
+            first = self._sample(logits, sub, self.sampling)
+        METRICS.inc("engine.prefill_tokens", n)
+
+        st = _Active(seq_id=req.seq_id, slot=slot, prompt_tokens=n,
+                     max_new_tokens=req.max_new_tokens,
+                     stop_strings=req.stop_strings)
+        token = int(first[0])
+        st.generated.append(token)
+        self._active[slot] = st
+        self.lengths[slot] = n
+        self.cur_tokens[slot] = token
+        reason = self._finish_reason(st, token, n)
+        if reason is not None:
+            return self._retire(slot, reason)
+        return None
+
+    def _grow(self, slot: int) -> None:
+        st = self._active[slot]
+        idx = int(self.lengths[slot]) // self.page_size
+        if idx >= self.pages_per_seq:
+            return                              # at cap; finish_reason handles
+        if self.block_tables[slot, idx] != TRASH_PAGE:
+            return                              # page already present
+        (page,) = self.allocator.alloc(1, owner=st.seq_id)
+        self.block_tables[slot, idx] = page
+
+    def _preempt_youngest(self, exclude: Optional[int] = None) -> bool:
+        """Evict the most-recently-admitted active sequence; requeue it."""
+        candidates = [s for s in self._active if s != exclude]
+        if not candidates:
+            return False
+        slot = max(candidates, key=lambda s: self._active[s].seq_id)
+        self._preempt_slot(slot)
+        return True
+
+    def _preempt_slot(self, slot: int) -> None:
+        st = self._active.pop(slot)
+        pages = [int(p) for p in self.block_tables[slot]
+                 if p != TRASH_PAGE]
+        self.allocator.free(pages, owner=st.seq_id)
+        self.block_tables[slot] = TRASH_PAGE
+        self._free_slots.append(slot)
+        # requeue at the FRONT with context so far; re-prefill resumes it.
+        # generated-so-far moves into the resume prompt and is remembered in
+        # _resumed so the final SequenceResult still reports the ORIGINAL
+        # prompt/completion split.
+        prefix = self._resumed.get(st.seq_id, []) + st.generated
+        self._resumed[st.seq_id] = prefix
+        resumed_prompt = self._prompts[st.seq_id] + prefix
+        remaining = max(1, st.max_new_tokens - len(st.generated))
+        log.info("preempting seq %d (slot %d, %d tokens) to free pages",
+                 st.seq_id, slot, len(resumed_prompt))
+        METRICS.inc("engine.preemptions", 1)
+        self._pending.insert(0, _Pending(
+            st.seq_id, resumed_prompt, remaining, st.stop_strings))
+
+    def _retire(self, slot: int, reason: str) -> SequenceResult:
+        st = self._active.pop(slot)
+        pages = [int(p) for p in self.block_tables[slot]
+                 if p != TRASH_PAGE]
+        self.allocator.free(pages, owner=st.seq_id)
+        self.allocator.check()
+        self.block_tables[slot] = TRASH_PAGE
+        self._free_slots.append(slot)
+        # a preempted-and-resumed sequence's st.generated holds only the
+        # post-resume tokens; stitch the pre-preemption prefix back on and
+        # report against the ORIGINAL prompt
+        orig_prompt = self._prompts.pop(st.seq_id)
+        generated = self._resumed.pop(st.seq_id, []) + st.generated
+        text = self._final_text(generated, reason, st.stop_strings)
+        return SequenceResult(
+            seq_id=st.seq_id, token_ids=list(generated), text=text,
+            finish_reason=reason, prompt_tokens=len(orig_prompt),
+            completion_tokens=len(generated))

@@ -1,0 +1,187 @@
+"""Pallas TPU flash-attention kernel (prefill path).
+
+Tiled online-softmax attention: the [S_q, S_k] score matrix is never
+materialised in HBM.  Grid is (batch, q_head, q_block, k_block) with the
+k_block axis innermost so the running max / denominator / accumulator for
+one q tile stay resident in VMEM scratch across the whole k sweep.  GQA is
+expressed in the BlockSpec index map (q head h reads kv head h // n_rep) —
+no repeat_kv materialisation.
+
+Numerics match ops.attention.causal_attention (the pure-XLA reference path
+used on CPU and in tests); see tests/test_kernels.py.  The reference
+repository has no kernels at all — its attention runs server-side behind
+the OpenAI API (reference common/openai_generic_assistant.py:45-51) — so
+this file is the "native kernel" layer SURVEY §2.2 requires the TPU build
+to add.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128          # VPU lane width: scratch rows are padded to this
+
+
+def _flash_kernel(
+    seq_lens_ref,       # SMEM [B]  (valid kv length per batch row)
+    q_off_ref,          # SMEM [B]  (absolute position of q block row 0)
+    q_ref,              # VMEM [1, block_q, 1, d]
+    k_ref,              # VMEM [1, block_k, 1, d]
+    v_ref,              # VMEM [1, block_k, 1, d]
+    o_ref,              # VMEM [1, block_q, 1, d]
+    acc_ref,            # VMEM scratch [block_q, d] f32
+    m_ref,              # VMEM scratch [block_q, _LANES] f32
+    l_ref,              # VMEM scratch [block_q, _LANES] f32
+    *,
+    block_q: int,
+    block_k: int,
+):
+    bi = pl.program_id(0)
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [bq, d]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)          # [bk, d]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)          # [bk, d]
+
+    d = q.shape[-1]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+    s = jax.lax.dot_general(
+        q * scale, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                  # [bq, bk]
+
+    qi = pl.program_id(2)
+    q_pos = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+             + qi * block_q + q_off_ref[bi])
+    k_pos = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+             + ki * block_k)
+    mask = (q_pos >= k_pos) & (k_pos < seq_lens_ref[bi])
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[:, 0:1]                             # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)         # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # fully-masked rows keep m == NEG_INF; shift so exp() stays finite
+    p = jnp.exp(s - jnp.where(m_new <= NEG_INF / 2, 0.0, m_new))
+    correction = jnp.exp(m_prev - jnp.where(m_new <= NEG_INF / 2, 0.0, m_new))
+
+    l_prev = l_ref[:, 0:1]
+    l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        p, v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)           # padded q rows
+        o_ref[0, :, 0, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,          # [B, S_q, n_heads, d]
+    k: jnp.ndarray,          # [B, S_k, n_kv, d]
+    v: jnp.ndarray,          # [B, S_k, n_kv, d]
+    seq_lens: jnp.ndarray,   # [B] valid kv lengths
+    q_offset: jnp.ndarray | None = None,   # [B] absolute pos of q[:, 0]
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Drop-in for ops.attention.causal_attention on TPU.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
+    same code path is exercised hermetically in CPU tests.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, s_q, n_heads, d = q.shape
+    s_k = k.shape[1]
+    n_kv = k.shape[2]
+    n_rep = n_heads // n_kv
+
+    if q_offset is None:
+        q_offset = jnp.zeros((b,), jnp.int32)
+
+    block_q = min(block_q, max(8, s_q))
+    block_k = min(block_k, max(8, s_k))
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    n_q_blocks = qp.shape[1] // block_q
+    n_k_blocks = kp.shape[1] // block_k
+
+    grid = (b, n_heads, n_q_blocks, n_k_blocks)
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, 1, d),
+                         lambda bi, h, qi, ki: (bi, qi, h, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, h, qi, ki: (bi, ki, h // n_rep, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, h, qi, ki: (bi, ki, h // n_rep, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, d),
+                               lambda bi, h, qi, ki: (bi, qi, h, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        seq_lens.astype(jnp.int32),
+        q_offset.astype(jnp.int32),
+        qp, kp, vp,
+    )
+    return out[:, :s_q]

@@ -1,0 +1,199 @@
+"""Pallas TPU paged-attention kernel (decode path).
+
+Decode attention where each sequence's KV lives in non-contiguous
+fixed-size pages of a shared pool (vLLM-style block tables, re-designed
+for the TPU: the page gather is expressed through a scalar-prefetched
+BlockSpec index map, so Pallas's own pipelining DMAs exactly the pages
+named by the block table — no host-side gather, no dense [B, S_max]
+cache).
+
+Layouts:
+- ``k_pages``/``v_pages``: [n_kv_heads, n_pages, page_size, head_dim] —
+  head-major so one (head, page) block is contiguous in HBM.
+- ``block_tables``: [B, pages_per_seq] int32 page ids; entries past a
+  sequence's length MUST still be valid ids (the allocator uses 0) —
+  they are fetched but masked out of the softmax.
+- ``lengths``: [B] valid kv tokens per sequence (including the current
+  decode position).
+
+Grid is (batch, kv_head, page); the page axis is innermost and carries
+running max / denominator / accumulator scratch across the sweep
+(online softmax, same scheme as ops/flash_attention.py).  All n_rep
+GQA query heads for one kv head are processed together as the rows of
+an [n_rep, d] tile.
+
+The reference has no KV cache at all (server-side, reference
+common/openai_generic_assistant.py:45-51); SURVEY §2.2 names the paged
+KV cache + kernel as a required TPU-native component.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _paged_kernel(
+    lengths_ref,        # SMEM [B]
+    tables_ref,         # SMEM [B, pages_per_seq]  (index-map only)
+    q_ref,              # VMEM [1, 1, n_rep, d]
+    k_ref,              # VMEM [1, 1, page_size, d]
+    v_ref,              # VMEM [1, 1, page_size, d]
+    o_ref,              # VMEM [1, 1, n_rep, d]
+    acc_ref,            # VMEM scratch [n_rep, d] f32
+    m_ref,              # VMEM scratch [n_rep, _LANES] f32
+    l_ref,              # VMEM scratch [n_rep, _LANES] f32
+    *,
+    page_size: int,
+):
+    del tables_ref
+    bi = pl.program_id(0)
+    j = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[bi]
+
+    @pl.when(j * page_size < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [n_rep, d]
+        k = k_ref[0, 0].astype(jnp.float32)            # [page, d]
+        v = v_ref[0, 0].astype(jnp.float32)            # [page, d]
+        n_rep = q.shape[0]
+
+        scale = jax.lax.rsqrt(jnp.float32(q.shape[-1]))
+        s = jax.lax.dot_general(
+            q * scale, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [n_rep, page]
+
+        k_pos = (jax.lax.broadcasted_iota(jnp.int32, (n_rep, page_size), 1)
+                 + j * page_size)
+        s = jnp.where(k_pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - shift)
+        correction = jnp.exp(m_prev - shift)
+
+        l_ref[:, 0:1] = l_ref[:, 0:1] * correction + jnp.sum(
+            p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(
+    q: jnp.ndarray,             # [B, n_heads, d]
+    k_pages: jnp.ndarray,       # [n_kv, n_pages, page_size, d]
+    v_pages: jnp.ndarray,       # [n_kv, n_pages, page_size, d]
+    lengths: jnp.ndarray,       # [B] int32
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    *,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Single-step decode attention over a paged KV pool: [B, n_heads, d]."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, n_heads, d = q.shape
+    n_kv, _, page_size, _ = k_pages.shape
+    n_rep = n_heads // n_kv
+    pages_per_seq = block_tables.shape[1]
+
+    q4 = q.reshape(b, n_kv, n_rep, d)
+    grid = (b, n_kv, pages_per_seq)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, page_size=page_size),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, n_rep, d),
+                             lambda bi, h, j, lens, tabs: (bi, h, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda bi, h, j, lens, tabs:
+                             (h, tabs[bi, j], 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda bi, h, j, lens, tabs:
+                             (h, tabs[bi, j], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, n_rep, d),
+                                   lambda bi, h, j, lens, tabs:
+                                   (bi, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n_rep, d), jnp.float32),
+                pltpu.VMEM((n_rep, _LANES), jnp.float32),
+                pltpu.VMEM((n_rep, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, n_rep, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        q4, k_pages, v_pages,
+    )
+    return out.reshape(b, n_heads, d)
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,
+    block_tables: jnp.ndarray,
+) -> jnp.ndarray:
+    """Pure-XLA reference implementation (gather + masked softmax).
+
+    Ground truth for the kernel's unit tests and the fallback for
+    platforms without Mosaic.
+    """
+    b, n_heads, d = q.shape
+    n_kv, _, page_size, _ = k_pages.shape
+    n_rep = n_heads // n_kv
+
+    # [B, n_kv, pages_per_seq, page, d] -> [B, S_max, n_kv, d]
+    k = jnp.take(k_pages, block_tables, axis=1)        # [n_kv, B, pp, page, d]
+    v = jnp.take(v_pages, block_tables, axis=1)
+    k = k.transpose(1, 2, 3, 0, 4).reshape(b, -1, n_kv, d)
+    v = v.transpose(1, 2, 3, 0, 4).reshape(b, -1, n_kv, d)
+
+    k = jnp.repeat(k, n_rep, axis=2).astype(jnp.float32)
+    v = jnp.repeat(v, n_rep, axis=2).astype(jnp.float32)
+    qf = q.astype(jnp.float32) / jnp.sqrt(jnp.float32(d))
+
+    s = jnp.einsum("bhd,bkhd->bhk", qf, k)
+    k_pos = jnp.arange(k.shape[1])[None, None, :]
+    s = jnp.where(k_pos < lengths[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v)
+    return out.astype(q.dtype)

@@ -56,7 +56,6 @@ def _moe_local(x, router_w, w_gate, w_up, w_down, *, axis_name: str,
                n_experts: int, top_k: int, capacity: int):
     """Under shard_map: x [T_local, H] (sharded over 'data'); expert weights
     sharded over ``axis_name`` (leading dim E/P)."""
-    n_dev = jax.lax.axis_size(axis_name)
     dispatch, combine = _route_exact(x, router_w, n_experts, top_k, capacity)
 
     # pack: [T, E, C] x [T, H] -> [E, C, H]

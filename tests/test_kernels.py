@@ -1,0 +1,102 @@
+"""Pallas kernels vs pure-XLA reference implementations.
+
+Runs hermetically on CPU via the Pallas interpreter (auto-selected when
+the backend is not TPU), so kernel logic is covered without hardware —
+the CPU-fallback test path SURVEY §4 calls for.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_rca_tpu.ops.attention import causal_attention
+from k8s_llm_rca_tpu.ops.flash_attention import flash_attention
+from k8s_llm_rca_tpu.ops.paged_attention import (
+    paged_attention, paged_attention_xla,
+)
+
+
+def _mk_qkv(key, b, s, n_heads, n_kv, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, n_heads, d), dtype)
+    k = jax.random.normal(kk, (b, s, n_kv, d), dtype)
+    v = jax.random.normal(kv, (b, s, n_kv, d), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("n_heads,n_kv", [(4, 4), (8, 2)])
+    def test_matches_reference(self, n_heads, n_kv):
+        b, s, d = 2, 96, 64          # s deliberately not a block multiple
+        q, k, v = _mk_qkv(jax.random.PRNGKey(0), b, s, n_heads, n_kv, d)
+        seq_lens = jnp.array([96, 57], jnp.int32)
+
+        ref = causal_attention(q, k, v, seq_lens)
+        out = flash_attention(q, k, v, seq_lens, block_q=32, block_k=32)
+        # rows past seq_len are padding garbage in both paths; compare valid
+        for bi, n in enumerate([96, 57]):
+            np.testing.assert_allclose(
+                np.asarray(out)[bi, :n], np.asarray(ref)[bi, :n],
+                rtol=2e-5, atol=2e-5)
+
+    def test_chunked_prefill_offset(self):
+        # queries for positions 32..63 attending to a 64-wide kv prefix
+        b, d = 1, 64
+        q, k, v = _mk_qkv(jax.random.PRNGKey(1), b, 64, 4, 4, d)
+        q_chunk = q[:, 32:64]
+        seq_lens = jnp.array([64], jnp.int32)
+        off = jnp.array([32], jnp.int32)
+
+        ref = causal_attention(q_chunk, k, v, seq_lens, q_offset=off)
+        out = flash_attention(q_chunk, k, v, seq_lens, off,
+                              block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bfloat16(self):
+        b, s, d = 1, 64, 64
+        q, k, v = _mk_qkv(jax.random.PRNGKey(2), b, s, 4, 4, d, jnp.bfloat16)
+        seq_lens = jnp.array([64], jnp.int32)
+        ref = causal_attention(q, k, v, seq_lens)
+        out = flash_attention(q, k, v, seq_lens, block_q=32, block_k=32)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=3e-2, atol=3e-2)
+
+
+class TestPagedAttention:
+    def _mk_pool(self, key, n_kv, n_pages, page, d):
+        kk, kv = jax.random.split(key)
+        kp = jax.random.normal(kk, (n_kv, n_pages, page, d))
+        vp = jax.random.normal(kv, (n_kv, n_pages, page, d))
+        return kp, vp
+
+    @pytest.mark.parametrize("n_heads,n_kv", [(4, 4), (8, 2)])
+    def test_matches_xla_reference(self, n_heads, n_kv):
+        b, d, page, n_pages, pp_seq = 3, 64, 16, 32, 4
+        key = jax.random.PRNGKey(3)
+        q = jax.random.normal(key, (b, n_heads, d))
+        kp, vp = self._mk_pool(jax.random.PRNGKey(4), n_kv, n_pages, page, d)
+        # scattered, non-contiguous page assignments; unused entries = 0
+        tables = jnp.array([[5, 9, 2, 0],
+                            [7, 0, 0, 0],
+                            [1, 30, 11, 21]], jnp.int32)
+        lengths = jnp.array([3 * page + 5, page - 2, 4 * page], jnp.int32)
+
+        ref = paged_attention_xla(q, kp, vp, lengths, tables)
+        out = paged_attention(q, kp, vp, lengths, tables)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        assert tables.shape == (b, pp_seq)
+
+    def test_single_token_sequence(self):
+        b, n_heads, n_kv, d, page = 1, 4, 4, 64, 16
+        q = jax.random.normal(jax.random.PRNGKey(5), (b, n_heads, d))
+        kp, vp = self._mk_pool(jax.random.PRNGKey(6), n_kv, 8, page, d)
+        tables = jnp.zeros((1, 2), jnp.int32).at[0, 0].set(3)
+        lengths = jnp.array([1], jnp.int32)
+        ref = paged_attention_xla(q, kp, vp, lengths, tables)
+        out = paged_attention(q, kp, vp, lengths, tables)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)

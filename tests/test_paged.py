@@ -1,0 +1,178 @@
+"""Paged KV cache: allocator invariants, model-path equivalence, engine
+churn + preemption.
+
+The allocator invariant tests are the "race detection" coverage SURVEY §5
+requires the build to add (the reference is single-threaded and has no
+cache to corrupt).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_rca_tpu.config import TINY, EngineConfig
+from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+from k8s_llm_rca_tpu.engine.paged import (
+    TRASH_PAGE, AllocatorError, OutOfPages, PageAllocator,
+    PagedInferenceEngine, init_paged_cache, paged_decode_step, paged_prefill,
+)
+from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+
+class TestPageAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = PageAllocator(16)
+        pages = a.alloc(5, owner=1)
+        assert len(set(pages)) == 5 and TRASH_PAGE not in pages
+        a.free(pages, owner=1)
+        a.check()
+        assert a.n_free == 15
+
+    def test_double_free_detected(self):
+        a = PageAllocator(8)
+        pages = a.alloc(2, owner=1)
+        a.free(pages, owner=1)
+        with pytest.raises(AllocatorError, match="double free"):
+            a.free(pages, owner=1)
+
+    def test_cross_owner_free_detected(self):
+        a = PageAllocator(8)
+        pages = a.alloc(2, owner=1)
+        with pytest.raises(AllocatorError, match="owned by"):
+            a.free(pages, owner=2)
+        a.check()
+
+    def test_exhaustion_raises(self):
+        a = PageAllocator(4)          # 3 usable
+        a.alloc(3, owner=1)
+        with pytest.raises(OutOfPages):
+            a.alloc(1, owner=2)
+
+    def test_trash_page_never_allocated(self):
+        a = PageAllocator(4)
+        assert TRASH_PAGE not in a.alloc(3, owner=1)
+        with pytest.raises(AllocatorError, match="trash"):
+            a.free([TRASH_PAGE], owner=1)
+
+
+class TestPagedModelPath:
+    """paged prefill+decode must produce the same greedy tokens as the
+    contiguous cache path."""
+
+    def _greedy_contiguous(self, cfg, params, prompt, n_steps):
+        cache = llama.init_cache(cfg, 1, cfg.max_seq_len)
+        toks = jnp.asarray([prompt], jnp.int32)
+        cache, logits = llama.prefill(cfg, params, cache, toks,
+                                      jnp.int32(len(prompt)), jnp.int32(0))
+        out = [int(jnp.argmax(logits[0]))]
+        lengths = jnp.asarray([len(prompt)], jnp.int32)
+        cur = jnp.asarray(out, jnp.int32)
+        for _ in range(n_steps - 1):
+            cache, logits = llama.decode_step(cfg, params, cache, cur, lengths)
+            lengths = lengths + 1
+            cur = jnp.asarray([int(jnp.argmax(logits[0]))], jnp.int32)
+            out.append(int(cur[0]))
+        return out
+
+    def test_greedy_equivalence(self):
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        page = 8
+        prompt = list(range(5, 18))      # 13 tokens -> 2 pages
+        ref = self._greedy_contiguous(cfg, params, prompt, 6)
+
+        k_pages, v_pages = init_paged_cache(cfg, 32, page)
+        # non-contiguous scattered pages on purpose
+        page_map = jnp.asarray([7, 3], jnp.int32)
+        padded = jnp.zeros((1, 16), jnp.int32).at[0, :13].set(
+            jnp.asarray(prompt))
+        k_pages, v_pages, logits = paged_prefill(
+            cfg, params, k_pages, v_pages, padded, jnp.int32(13), page_map)
+        got = [int(jnp.argmax(logits[0]))]
+
+        tables = np.full((1, 8), TRASH_PAGE, np.int32)
+        tables[0, :2] = [7, 3]
+        extra = [11, 5, 9, 2, 30, 29]     # pages for growth
+        lengths = 13
+        cur = got[0]
+        for _ in range(5):
+            if lengths % page == 0:
+                tables[0, lengths // page] = extra.pop(0)
+            k_pages, v_pages, logits = paged_decode_step(
+                cfg, params, k_pages, v_pages,
+                jnp.asarray([cur], jnp.int32),
+                jnp.asarray([lengths], jnp.int32),
+                jnp.asarray(tables), use_kernel=False)
+            lengths += 1
+            cur = int(jnp.argmax(logits[0]))
+            got.append(cur)
+        assert got == ref
+
+
+class TestPagedEngine:
+    def _engine(self, **kw):
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        defaults = dict(max_batch=4, max_seq_len=64, page_size=8,
+                        num_pages=64, prefill_buckets=(16, 32, 64),
+                        max_new_tokens=8, temperature=0.0)
+        defaults.update(kw)
+        ecfg = EngineConfig(**defaults)
+        tok = get_tokenizer()
+        return (PagedInferenceEngine(cfg, ecfg, params, tok,
+                                     use_kernel=False),
+                InferenceEngine(cfg, ecfg, params, tok), tok, cfg)
+
+    def test_matches_contiguous_engine(self):
+        paged, contiguous, tok, cfg = self._engine()
+        prompts = [tok.encode(t, add_bos=True) for t in
+                   ["pod crashloop", "pvc pending why", "node notready"]]
+        a = paged.generate(prompts, max_new_tokens=6)
+        b = contiguous.generate(prompts, max_new_tokens=6)
+        for ra, rb in zip(a, b):
+            assert ra.token_ids == rb.token_ids
+            assert ra.finish_reason == rb.finish_reason
+        paged.allocator.check()
+        assert paged.allocator.n_free == 63   # everything returned
+
+    def test_churn_many_sequences(self):
+        paged, _, tok, _ = self._engine(num_pages=32)
+        prompts = [tok.encode(f"incident number {i} pod failing", add_bos=True)
+                   for i in range(10)]
+        results = paged.generate(prompts, max_new_tokens=5)
+        assert len(results) == 10
+        assert sorted(r.seq_id for r in results) == list(range(10))
+        paged.allocator.check()
+        assert paged.allocator.n_free == 31
+
+    def test_lockstep_page_boundary_preemption(self):
+        # regression: two sequences admitted with identical prompt lengths
+        # hit a page boundary on the SAME tick with zero free pages; the
+        # growth loop must skip the slot that _preempt_youngest() evicted
+        # mid-loop instead of KeyError-ing on the stale snapshot.
+        paged, _, tok, _ = self._engine(
+            num_pages=5, max_batch=2, page_size=8, max_seq_len=32,
+            prefill_buckets=(16,), max_new_tokens=10)
+        prompt = tok.encode("0123456789abcde")   # 15 chars + BOS = 16 tokens
+        prompt = [tok.bos_id] + prompt
+        assert len(prompt) == 16
+        results = paged.generate([prompt, list(prompt)], max_new_tokens=10)
+        assert len(results) == 2
+        paged.allocator.check()
+        assert paged.allocator.n_free == 4
+
+    def test_preemption_under_pressure(self):
+        # pool barely holds one max sequence: concurrent seqs force preempts
+        paged, _, tok, _ = self._engine(num_pages=12, max_batch=3,
+                                        max_new_tokens=16)
+        prompts = [tok.encode("a b c d e f g h i j k l m n o p", add_bos=True)
+                   for _ in range(3)]
+        results = paged.generate(prompts, max_new_tokens=16)
+        assert len(results) == 3
+        for r in results:
+            assert r.completion_tokens >= 16 or r.finish_reason in (
+                "eos", "stop", "length")
+        paged.allocator.check()
+        assert paged.allocator.n_free == 11
