@@ -204,6 +204,7 @@ class RCAConfig:
     state_limit: int = 10
     run_timeout_s: float = 600.0
     model: str = "tiny"                # serve-side model name
+    rerank_top_k: int = 0              # cap audited records when reranking (0 = all)
 
 
 @dataclass(frozen=True)

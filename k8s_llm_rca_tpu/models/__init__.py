@@ -6,3 +6,4 @@ from k8s_llm_rca_tpu.models.llama import (  # noqa: F401
     prefill,
     decode_step,
 )
+from k8s_llm_rca_tpu.models import encoder, mixtral  # noqa: F401

@@ -1,0 +1,145 @@
+"""e5-family bidirectional text encoder (BERT architecture), TPU-first.
+
+Provides the embedding/rerank path of BASELINE config[4]: encode Neo4j
+result rows / STATE JSON projections into dense vectors on the TPU, so the
+RCA prompts carry only the most relevant evidence instead of whole
+subgraphs.  The reference has no retrieval at all — it pastes raw STATE
+projections into prompts (reference check_state/analyze_root_cause.py:225-230
+shrinks prompts by field projection only), so this is a new capability the
+survey calls out (SURVEY.md §2.2 "Embedding/rerank").
+
+Same functional style as models/llama.py: params are a plain pytree, config
+is static, and ``forward``/``embed`` jit once with static shapes.  All
+matmuls are batched [B,S,·]·[·,·] einsums so XLA tiles them onto the MXU in
+bf16; layer norms run in fp32 on the VPU (ops/norms.py).  Sharding: TP over
+"model" on attention heads and the FFN hidden dim via
+runtime/sharding.encoder_param_specs; batch shards over "data".
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_rca_tpu.config import EncoderConfig
+from k8s_llm_rca_tpu.ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+NEG_INF = -1e30
+
+
+def _dense(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(cfg: EncoderConfig, key: jax.Array) -> Params:
+    """Random init.  Real e5 checkpoints load via models/loader."""
+    dtype = jnp.dtype(cfg.dtype)
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    keys = jax.random.split(key, cfg.n_layers + 3)
+    scale = 1.0 / math.sqrt(h)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[i], 6)
+        layers.append({
+            "wq": _dense(lk[0], (h, h), scale, dtype),
+            "bq": jnp.zeros((h,), dtype),
+            "wk": _dense(lk[1], (h, h), scale, dtype),
+            "bk": jnp.zeros((h,), dtype),
+            "wv": _dense(lk[2], (h, h), scale, dtype),
+            "bv": jnp.zeros((h,), dtype),
+            "wo": _dense(lk[3], (h, h), scale / math.sqrt(2 * cfg.n_layers),
+                         dtype),
+            "bo": jnp.zeros((h,), dtype),
+            "attn_ln_w": jnp.ones((h,), dtype),
+            "attn_ln_b": jnp.zeros((h,), dtype),
+            "w_in": _dense(lk[4], (h, inter), scale, dtype),
+            "b_in": jnp.zeros((inter,), dtype),
+            "w_out": _dense(lk[5], (inter, h),
+                            scale / math.sqrt(2 * cfg.n_layers), dtype),
+            "b_out": jnp.zeros((h,), dtype),
+            "mlp_ln_w": jnp.ones((h,), dtype),
+            "mlp_ln_b": jnp.zeros((h,), dtype),
+        })
+
+    return {
+        "word_embedding": _dense(keys[-3], (cfg.vocab_size, h), 1.0, dtype),
+        "position_embedding": _dense(keys[-2], (cfg.max_seq_len, h), 0.02,
+                                     dtype),
+        "type_embedding": _dense(keys[-1], (2, h), 0.02, dtype),
+        "embed_ln_w": jnp.ones((h,), dtype),
+        "embed_ln_b": jnp.zeros((h,), dtype),
+        "layers": layers,
+    }
+
+
+def _self_attention(cfg: EncoderConfig, layer: Params, x: jnp.ndarray,
+                    pad_mask: jnp.ndarray) -> jnp.ndarray:
+    """Bidirectional multi-head attention.  x [B,S,H]; pad_mask [B,S] bool
+    (True = valid token).  Padding keys are masked to -inf in fp32."""
+    b, s, h = x.shape
+    nh = cfg.n_heads
+    d = h // nh
+    q = (x @ layer["wq"] + layer["bq"]).reshape(b, s, nh, d)
+    k = (x @ layer["wk"] + layer["bk"]).reshape(b, s, nh, d)
+    v = (x @ layer["wv"] + layer["bv"]).reshape(b, s, nh, d)
+
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(d)
+    logits = jnp.where(pad_mask[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(b, s, h)
+    return out @ layer["wo"] + layer["bo"]
+
+
+def forward(cfg: EncoderConfig, params: Params, tokens: jnp.ndarray,
+            lengths: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens [B,S] right-padded, lengths [B] -> hidden states [B,S,H].
+
+    Post-LN transformer encoder (BERT/e5 ordering: residual-add then
+    LayerNorm, GELU FFN).
+    """
+    b, s = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    pad_mask = jnp.arange(s)[None, :] < lengths[:, None]        # [B,S]
+    dtype = jnp.dtype(cfg.dtype)
+
+    x = (params["word_embedding"][tokens]
+         + params["position_embedding"][None, :s]
+         + params["type_embedding"][0][None, None]).astype(dtype)
+    x = layer_norm(x, params["embed_ln_w"], params["embed_ln_b"],
+                   cfg.layer_norm_eps)
+
+    for layer in params["layers"]:
+        attn = _self_attention(cfg, layer, x, pad_mask)
+        x = layer_norm(x + attn, layer["attn_ln_w"], layer["attn_ln_b"],
+                       cfg.layer_norm_eps)
+        ffn = jax.nn.gelu(x @ layer["w_in"] + layer["b_in"])
+        ffn = ffn @ layer["w_out"] + layer["b_out"]
+        x = layer_norm(x + ffn, layer["mlp_ln_w"], layer["mlp_ln_b"],
+                       cfg.layer_norm_eps)
+    return x
+
+
+def embed(cfg: EncoderConfig, params: Params, tokens: jnp.ndarray,
+          lengths: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Sentence embedding: mean-pool valid positions, L2-normalize.
+
+    Returns [B,H] fp32 unit vectors (the e5 recipe: average pooling over the
+    attention-unmasked tokens, then cosine similarity downstream).
+    """
+    b, s = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    hidden = forward(cfg, params, tokens, lengths).astype(jnp.float32)
+    mask = (jnp.arange(s)[None, :] < lengths[:, None]).astype(jnp.float32)
+    summed = jnp.einsum("bsh,bs->bh", hidden, mask)
+    pooled = summed / jnp.maximum(lengths[:, None].astype(jnp.float32), 1.0)
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)

@@ -1,0 +1,26 @@
+"""Mixtral-family sparse-MoE decoder LM.
+
+Architecturally this is the Llama stack with the MLP swapped for a
+top-k-routed expert block, so the implementation lives in models/llama.py
+(``n_experts > 0`` switches the block; see ``llama._moe_mlp`` for the dense
+soft-dispatch formulation and parallel/moe.py for the expert-parallel
+all-to-all dispatch used under an "expert" mesh axis).  This module is the
+family's named entry point: presets plus re-exported entry points, so model
+code reads ``from k8s_llm_rca_tpu.models import mixtral``.
+
+Replaces the reference's remote GPT-4 (its only model access is the HTTPS
+client, reference common/openai_generic_assistant.py:45-51) with the MoE
+assistant of BASELINE config[3] (Mixtral-8x7B expert-parallel on v5e-16).
+"""
+
+from __future__ import annotations
+
+from k8s_llm_rca_tpu.config import MIXTRAL_8X7B, TINY_MOE  # noqa: F401
+from k8s_llm_rca_tpu.models.llama import (  # noqa: F401
+    KVCache,
+    decode_step,
+    forward,
+    init_cache,
+    init_params,
+    prefill,
+)

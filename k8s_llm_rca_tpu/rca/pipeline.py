@@ -37,6 +37,10 @@ class RCAPipeline:
     state_executor: Any
     cfg: RCAConfig = field(default_factory=RCAConfig)
     sweep: SweepConfig = field(default_factory=SweepConfig)
+    # optional TPU embed+rerank of matched records (rca/rerank.Reranker);
+    # when set, statepath audits run in relevance order and can be capped
+    # with cfg.rerank_top_k
+    reranker: Optional[Any] = None
 
     def __post_init__(self):
         self.locator = locator.setup_root_cause_locator(
@@ -153,6 +157,12 @@ class RCAPipeline:
                 analysis: Dict[str, Any] = {"extend_metapath": metapath_str}
                 records = self.compile_and_run(metapath_str, error_message,
                                                analysis)
+                if self.reranker is not None and len(records) > 1:
+                    top_k = self.cfg.rerank_top_k or None
+                    ranked = self.reranker.rerank_records(
+                        error_message, records, top_k)
+                    records = [r for r, _ in ranked]
+                    analysis["rerank_scores"] = [s for _, s in ranked]
                 analysis["statepath"] = []
                 for record in records:
                     report, clues = auditor.check_statepath(

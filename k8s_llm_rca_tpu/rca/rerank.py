@@ -1,0 +1,134 @@
+"""TPU embedding + rerank of graph evidence (BASELINE config[4]).
+
+The reference pastes raw graph results into LLM prompts (its only context
+control is a 12-field projection, reference
+check_state/analyze_root_cause.py:225-230).  Here an e5-style encoder
+(models/encoder.py) runs on the TPU to embed the error message as a query
+and candidate evidence rows (statepath records, STATE JSON projections) as
+passages; cosine similarity reranks them so prompts carry the most relevant
+evidence first and sweeps can cap fan-out without losing signal.
+
+Batching: texts pad to a small set of bucket lengths so ``embed`` compiles
+once per (bucket, batch) shape; all candidates encode in one batched MXU
+pass rather than per-row round trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_rca_tpu.config import EncoderConfig, TINY_ENCODER
+from k8s_llm_rca_tpu.models import encoder
+from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer, get_tokenizer
+
+# e5 asymmetric-retrieval convention: queries and passages are prefixed so
+# the encoder can specialize each side.
+QUERY_PREFIX = "query: "
+PASSAGE_PREFIX = "passage: "
+
+
+class Embedder:
+    """Batched text -> unit-vector embeddings on the accelerator."""
+
+    def __init__(self, cfg: EncoderConfig = TINY_ENCODER,
+                 params: Optional[Any] = None,
+                 tokenizer: Optional[Tokenizer] = None,
+                 buckets: Sequence[int] = (32, 64, 128, 256, 512),
+                 batch_size: int = 32):
+        self.cfg = cfg
+        self.params = params if params is not None else encoder.init_params(
+            cfg, jax.random.PRNGKey(0))
+        self.tokenizer = tokenizer or get_tokenizer(vocab_size=cfg.vocab_size)
+        self.buckets = tuple(b for b in sorted(buckets)
+                             if b <= cfg.max_seq_len) or (cfg.max_seq_len,)
+        self.batch_size = batch_size
+        self._embed = jax.jit(encoder.embed, static_argnums=0)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        """texts -> [N, H] fp32 unit vectors."""
+        if not texts:
+            return np.zeros((0, self.cfg.hidden_size), np.float32)
+        ids = [self.tokenizer.encode(t)[: self.cfg.max_seq_len]
+               for t in texts]
+        out = np.zeros((len(texts), self.cfg.hidden_size), np.float32)
+        # group by padded bucket so each (bucket, batch) shape jits once
+        order = sorted(range(len(ids)), key=lambda i: len(ids[i]))
+        for start in range(0, len(order), self.batch_size):
+            group = order[start:start + self.batch_size]
+            width = self._bucket(max(len(ids[i]) for i in group))
+            tokens = np.zeros((len(group), width), np.int32)
+            lengths = np.zeros((len(group),), np.int32)
+            for row, i in enumerate(group):
+                seq = ids[i][:width] or [0]
+                tokens[row, : len(seq)] = seq
+                lengths[row] = len(seq)
+            vecs = self._embed(self.cfg, self.params, jnp.asarray(tokens),
+                               jnp.asarray(lengths))
+            out[group] = np.asarray(vecs)
+        return out
+
+
+def cosine_rerank(query_vec: np.ndarray, passage_vecs: np.ndarray
+                  ) -> List[Tuple[int, float]]:
+    """Unit vectors in -> [(index, score)] sorted by descending similarity."""
+    scores = passage_vecs @ query_vec
+    order = np.argsort(-scores, kind="stable")
+    return [(int(i), float(scores[i])) for i in order]
+
+
+class Reranker:
+    """Query-vs-passages reranker used by the RCA pipeline to order graph
+    evidence before it reaches the prompt window."""
+
+    def __init__(self, embedder: Optional[Embedder] = None):
+        self.embedder = embedder or Embedder()
+
+    def rerank(self, query: str, passages: Sequence[str],
+               top_k: Optional[int] = None) -> List[Tuple[int, float]]:
+        if not passages:
+            return []
+        qv = self.embedder.encode([QUERY_PREFIX + query])[0]
+        pv = self.embedder.encode([PASSAGE_PREFIX + p for p in passages])
+        ranked = cosine_rerank(qv, pv)
+        return ranked[:top_k] if top_k else ranked
+
+    def rerank_records(self, error_message: str, records: Sequence[Any],
+                       top_k: Optional[int] = None
+                       ) -> List[Tuple[Any, float]]:
+        """Order statepath records by embedding relevance to the incident.
+        Records render through their graph-element repr (kinds, names, ids)."""
+        texts = [_record_text(r) for r in records]
+        ranked = self.rerank(error_message, texts, top_k)
+        return [(records[i], score) for i, score in ranked]
+
+
+def _record_text(record: Any) -> str:
+    """Flatten a statepath record into text for embedding: node kinds and
+    name-ish keys, edge types — enough signal for relevance without the
+    full JSON payloads."""
+    parts: List[str] = []
+    try:
+        elements = list(record)
+    except TypeError:
+        return str(record)
+    for ele in elements:
+        props = getattr(ele, "properties", None)
+        if isinstance(props, dict):
+            for key in ("kind", "kind2", "tag", "name2", "val", "path",
+                        "containerName", "imageName", "message"):
+                v = props.get(key)
+                if v:
+                    parts.append(str(v))
+        else:
+            parts.append(str(ele))
+    return " ".join(parts) if parts else str(record)

@@ -66,6 +66,33 @@ def llama_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
     return specs
 
 
+def encoder_param_specs(cfg) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models/encoder.init_params structure.
+
+    Same TP layout as the decoder: q/k/v column-parallel (heads sharded over
+    "model"), wo row-parallel, FFN hidden dim sharded.  Biases of sharded
+    columns shard on the same axis; LayerNorm params replicate.
+    """
+    layer = {
+        "wq": P(None, "model"), "bq": P("model"),
+        "wk": P(None, "model"), "bk": P("model"),
+        "wv": P(None, "model"), "bv": P("model"),
+        "wo": P("model", None), "bo": P(None),
+        "attn_ln_w": P(None), "attn_ln_b": P(None),
+        "w_in": P(None, "model"), "b_in": P("model"),
+        "w_out": P("model", None), "b_out": P(None),
+        "mlp_ln_w": P(None), "mlp_ln_b": P(None),
+    }
+    return {
+        "word_embedding": P(None, "model"),
+        "position_embedding": P(None, "model"),
+        "type_embedding": P(None, "model"),
+        "embed_ln_w": P(None),
+        "embed_ln_b": P(None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
 def kv_cache_specs() -> Any:
     """KV cache [L, B, S, n_kv, d]: batch on data, kv-heads on model."""
     return P(None, "data", None, "model", None)

@@ -1,0 +1,139 @@
+"""Encoder + embed/rerank tests (BASELINE config[4] path).
+
+Invariants: bidirectionality (a late-token perturbation changes early
+hidden states — the opposite of the decoder's causality test), padding
+invariance (padded positions must not leak into the pooled embedding),
+unit-norm pooling, deterministic rerank ordering, and the pipeline
+integration (rerank_scores present and record order by score).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_llm_rca_tpu.config import TINY_ENCODER, RCAConfig
+from k8s_llm_rca_tpu.models import encoder
+from k8s_llm_rca_tpu.rca.rerank import (
+    Embedder, Reranker, cosine_rerank, _record_text,
+)
+
+
+@pytest.fixture(scope="module")
+def enc_setup():
+    cfg = TINY_ENCODER
+    params = encoder.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_forward_shapes_and_finite(enc_setup):
+    cfg, params = enc_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    hidden = encoder.forward(cfg, params, tokens)
+    assert hidden.shape == (2, 16, cfg.hidden_size)
+    assert bool(jnp.all(jnp.isfinite(hidden)))
+
+
+def test_bidirectional(enc_setup):
+    """Perturbing a LATE token must change EARLY hidden states (no causal
+    mask — this is the defining difference from the decoder)."""
+    cfg, params = enc_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 12), 0,
+                                cfg.vocab_size)
+    perturbed = tokens.at[0, 10].set((tokens[0, 10] + 1) % cfg.vocab_size)
+    ha = encoder.forward(cfg, params, tokens)
+    hb = encoder.forward(cfg, params, perturbed)
+    assert not np.allclose(ha[0, :5], hb[0, :5], atol=1e-5)
+
+
+def test_padding_invariance(enc_setup):
+    """Same valid tokens under different pad widths -> same embedding."""
+    cfg, params = enc_setup
+    base = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0,
+                              cfg.vocab_size)
+    lengths = jnp.array([6], jnp.int32)
+    short = jnp.zeros((1, 8), jnp.int32).at[:, :6].set(base)
+    long = jnp.full((1, 16), 99, jnp.int32).at[:, :6].set(base)
+    ea = encoder.embed(cfg, params, short, lengths)
+    eb = encoder.embed(cfg, params, long, lengths)
+    np.testing.assert_allclose(np.asarray(ea), np.asarray(eb),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_embed_unit_norm(enc_setup):
+    cfg, params = enc_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (3, 10), 0,
+                                cfg.vocab_size)
+    vecs = encoder.embed(cfg, params, tokens)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(vecs), axis=-1),
+                               np.ones(3), rtol=1e-5)
+
+
+def test_embedder_batches_and_buckets():
+    emb = Embedder(buckets=(8, 16), batch_size=2)
+    texts = ["pod failed", "a much longer message about a configmap that "
+             "does not exist in the namespace", "x", "secret missing"]
+    vecs = emb.encode(texts)
+    assert vecs.shape == (4, emb.cfg.hidden_size)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), np.ones(4),
+                               rtol=1e-5)
+    # per-text embedding must not depend on batch composition
+    solo = emb.encode([texts[1]])
+    np.testing.assert_allclose(vecs[1], solo[0], rtol=1e-4, atol=1e-4)
+
+
+def test_cosine_rerank_orders_by_similarity():
+    q = np.array([1.0, 0.0], np.float32)
+    p = np.array([[0.6, 0.8], [1.0, 0.0], [0.0, 1.0]], np.float32)
+    ranked = cosine_rerank(q, p)
+    assert [i for i, _ in ranked] == [1, 0, 2]
+    assert ranked[0][1] == pytest.approx(1.0)
+
+
+def test_reranker_identical_passage_wins():
+    """The passage equal to the query must embed closest to it."""
+    rr = Reranker()
+    query = "MountVolume failed for volume secret not found"
+    passages = ["completely unrelated text about networking",
+                query,
+                "another unrelated row"]
+    ranked = rr.rerank(query, passages)
+    assert ranked[0][0] == 1
+
+
+def test_record_text_flattens_graph_elements():
+    from k8s_llm_rca_tpu.graph.store import Node
+
+    n1 = Node("e1", ["Entity"], {"kind": "pod", "name2": "web-1"})
+    n2 = Node("e2", ["Entity"], {"kind": "secret", "val": "db-cred"})
+    text = _record_text([n1, n2])
+    assert "pod" in text and "web-1" in text and "db-cred" in text
+
+
+def test_pipeline_rerank_integration():
+    """Full hermetic pipeline with a reranker: rerank_scores recorded,
+    descending, and statepath audits still produce reports."""
+    from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+    from k8s_llm_rca_tpu.graph.fixtures import (
+        INCIDENTS, build_metagraph, build_stategraph,
+    )
+    from k8s_llm_rca_tpu.rca import RCAPipeline
+    from k8s_llm_rca_tpu.rca.oracle import OracleBackend
+    from k8s_llm_rca_tpu.serve.api import AssistantService
+    from k8s_llm_rca_tpu.utils import get_tokenizer
+
+    pipeline = RCAPipeline(
+        AssistantService(OracleBackend(get_tokenizer())),
+        InMemoryGraphExecutor(build_metagraph()),
+        InMemoryGraphExecutor(build_stategraph()),
+        RCAConfig(),
+        reranker=Reranker())
+    result = pipeline.analyze_incident(INCIDENTS[0].message)
+    assert result["analysis"], "pipeline found no metapaths"
+    audited = [sp for a in result["analysis"] for sp in a["statepath"]]
+    assert audited, "no statepath audits ran"
+    for analysis in result["analysis"]:
+        scores = analysis.get("rerank_scores")
+        if scores is not None:
+            assert scores == sorted(scores, reverse=True)
