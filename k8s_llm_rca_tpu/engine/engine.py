@@ -117,6 +117,23 @@ class EngineBase:
     def has_work(self) -> bool:
         return bool(self._active or self._pending)
 
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        stop_strings: Sequence[str] = (),
+    ) -> int:
+        """Queue a sequence; returns its seq_id.  Non-blocking."""
+        seq_id = next(self._seq_counter)
+        prompt_ids, max_new = self._clamp_prompt(prompt_ids, max_new_tokens)
+        self._register(seq_id, prompt_ids)
+        self._pending.append(
+            _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings)))
+        return seq_id
+
+    def _register(self, seq_id: int, prompt_ids: List[int]) -> None:
+        """Subclass hook called once per submitted sequence."""
+
     def step(self) -> List[SequenceResult]:
         raise NotImplementedError
 
@@ -152,12 +169,19 @@ class EngineBase:
             # decode only a bounded tail window: a token covers >= 1 char,
             # so a window of max_stop_chars + 8 tokens always contains any
             # stop string that just completed (avoids O(n^2) re-decoding).
+            # _stop_context (not st.generated directly) so a stop string
+            # spanning a preemption/resume boundary is still seen.
             window = max(len(s) for s in st.stop_strings) + 8
-            text = self.tokenizer.decode(st.generated[-window:])
+            text = self.tokenizer.decode(self._stop_context(st)[-window:])
             for s in st.stop_strings:
                 if s in text:
                     return "stop"
         return None
+
+    def _stop_context(self, st: _Active) -> List[int]:
+        """Tokens eligible for stop-string matching; subclasses prepend any
+        pre-preemption generation so matches can span a resume boundary."""
+        return st.generated
 
     def _final_text(self, generated: List[int], reason: str,
                     stop_strings: Tuple[str, ...]) -> str:
@@ -214,19 +238,6 @@ class InferenceEngine(EngineBase):
         ) or (engine_cfg.max_seq_len,)
 
     # ------------------------------------------------------------------ api
-
-    def submit(
-        self,
-        prompt_ids: Sequence[int],
-        max_new_tokens: Optional[int] = None,
-        stop_strings: Sequence[str] = (),
-    ) -> int:
-        """Queue a sequence; returns its seq_id.  Non-blocking."""
-        seq_id = next(self._seq_counter)
-        prompt_ids, max_new = self._clamp_prompt(prompt_ids, max_new_tokens)
-        self._pending.append(
-            _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings)))
-        return seq_id
 
     def step(self) -> List[SequenceResult]:
         """One engine tick: admit pending into free slots, then one decode

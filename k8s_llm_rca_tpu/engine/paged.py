@@ -139,19 +139,7 @@ def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
     _, s_pad = tokens.shape
     page_size = k_pages.shape[3]
     assert s_pad % page_size == 0, (s_pad, page_size)
-    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-    positions = jnp.arange(s_pad)[None, :]
-    seq_lens = jnp.asarray(length).reshape(1)
-    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
-
-    ks, vs = [], []
-    for layer in params["layers"]:
-        x, k, v = llama._block_prefill(cfg, layer, x, angles, positions,
-                                       seq_lens)
-        ks.append(k[0])                       # [S_pad, n_kv, d]
-        vs.append(v[0])
-    new_k = jnp.stack(ks)                     # [L, S_pad, n_kv, d]
-    new_v = jnp.stack(vs)
+    new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length)
 
     n_seq_pages = s_pad // page_size
     # [L, S_pad, n_kv, d] -> [L, n_kv, n_seq_pages, page_size, d]
@@ -162,9 +150,6 @@ def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
 
     k_pages = k_pages.at[:, :, page_map].set(to_pages(new_k))
     v_pages = v_pages.at[:, :, page_map].set(to_pages(new_v))
-
-    last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
-    logits = llama._logits(cfg, params, last)[:, 0]
     return k_pages, v_pages, logits
 
 
@@ -225,10 +210,12 @@ class PagedInferenceEngine(EngineBase):
     Differences from engine.InferenceEngine (contiguous):
     - pages are allocated per sequence: ceil(prompt/page) at admission,
       +1 page whenever decode crosses a page boundary;
-    - if the pool is exhausted, the **youngest** active sequence is
-      preempted: its pages are freed and it is requeued with
-      prompt+generated as the new prompt (SURVEY §5 failure-recovery:
-      engine-level preemption/requeue);
+    - if the pool is exhausted when an active sequence must grow, the
+      **youngest** active sequence is preempted: its pages are freed and it
+      is requeued with prompt+generated as the new prompt (SURVEY §5
+      failure-recovery: engine-level preemption/requeue).  Admission never
+      preempts — queued requests wait for retirements instead of evicting
+      running work;
     - block tables live on the host (numpy) and ship to the device as a
       [B, pages_per_seq] int32 each tick (tiny).
     """
@@ -274,10 +261,15 @@ class PagedInferenceEngine(EngineBase):
         self._resumed: Dict[int, List[int]] = {}   # seq_id -> pre-preemption
                                                    #           generated tokens
 
-        self._prefill = jax.jit(paged_prefill, static_argnums=0)
+        # donate the KV pool so XLA updates it in place — without donation
+        # every tick copies the whole pool and peak HBM doubles.  (CPU has
+        # no donation support and would warn on every compile, so gate it.)
+        donate = (2, 3) if jax.default_backend() == "tpu" else ()
+        self._prefill = jax.jit(paged_prefill, static_argnums=0,
+                                donate_argnums=donate)
         self._decode = jax.jit(
             paged_decode_step, static_argnums=(0,),
-            static_argnames=("use_kernel",))
+            donate_argnums=donate, static_argnames=("use_kernel",))
         self._sample = jax.jit(sample_tokens, static_argnums=2)
 
         self._buckets = tuple(
@@ -286,15 +278,14 @@ class PagedInferenceEngine(EngineBase):
 
     # ------------------------------------------------------------------ api
 
-    def submit(self, prompt_ids: Sequence[int],
-               max_new_tokens: Optional[int] = None,
-               stop_strings: Sequence[str] = ()) -> int:
-        seq_id = next(self._seq_counter)
-        prompt_ids, max_new = self._clamp_prompt(prompt_ids, max_new_tokens)
+    def _register(self, seq_id: int, prompt_ids: List[int]) -> None:
         self._prompts[seq_id] = list(prompt_ids)
-        self._pending.append(
-            _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings)))
-        return seq_id
+
+    def _stop_context(self, st: _Active) -> List[int]:
+        # include pre-preemption tokens so stop strings spanning the
+        # resume boundary still match
+        prefix = self._resumed.get(st.seq_id)
+        return prefix + st.generated if prefix else st.generated
 
     def step(self) -> List[SequenceResult]:
         finished: List[SequenceResult] = []
@@ -303,9 +294,13 @@ class PagedInferenceEngine(EngineBase):
             try:
                 early = self._admit(pend)
             except OutOfPages:
-                if not self._preempt_youngest():
-                    break                       # nothing to evict; wait
-                continue
+                # Admission never preempts: evicting a running sequence to
+                # admit a queued one just swaps which request waits while
+                # paying a re-prefill (and it livelocks when the evictee is
+                # requeued at the front).  Wait for retirements to free
+                # pages; only the growth path below preempts, because a
+                # sequence that cannot grow cannot make progress at all.
+                break
             self._pending.pop(0)
             if early is not None:
                 finished.append(early)

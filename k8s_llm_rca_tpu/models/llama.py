@@ -202,14 +202,16 @@ def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     return _logits(cfg, params, x)
 
 
-def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
-            tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray
-            ) -> Tuple[KVCache, jnp.ndarray]:
-    """Prefill ONE sequence into cache slot ``slot``.
+def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
+               length: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared prefill compute for both cache designs (contiguous slot write
+    below, page scatter in engine/paged.py): run the stack over ONE
+    right-padded sequence and return its full-depth KV plus the last valid
+    token's logits.
 
-    tokens [1, S_pad] right-padded; ``length`` scalar valid length; returns
-    (cache', last-token logits [1, V]).  One compile per padded bucket length
-    (engine/engine.py buckets prompt lengths to keep recompiles bounded).
+    tokens [1, S_pad], ``length`` scalar valid length.  Returns
+    (new_k [L, S_pad, n_kv, d], new_v likewise, logits [1, V]).
     """
     _, s_pad = tokens.shape
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
@@ -222,17 +224,28 @@ def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
         x, k, v = _block_prefill(cfg, layer, x, angles, positions, seq_lens)
         ks.append(k[0])  # [S_pad, n_kv, d]
         vs.append(v[0])
-    new_k = jnp.stack(ks)  # [L, S_pad, n_kv, d]
-    new_v = jnp.stack(vs)
+
+    last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)  # [1,1,H]
+    logits = _logits(cfg, params, last)[:, 0]                       # [1, V]
+    return jnp.stack(ks), jnp.stack(vs), logits
+
+
+def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
+            tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray
+            ) -> Tuple[KVCache, jnp.ndarray]:
+    """Prefill ONE sequence into cache slot ``slot``.
+
+    tokens [1, S_pad] right-padded; ``length`` scalar valid length; returns
+    (cache', last-token logits [1, V]).  One compile per padded bucket length
+    (engine/engine.py buckets prompt lengths to keep recompiles bounded).
+    """
+    new_k, new_v, logits = prefill_kv(cfg, params, tokens, length)
 
     # write [L, 1, S_pad, ...] into the slot row at sequence offset 0
     k_cache = jax.lax.dynamic_update_slice(
         cache.k, new_k[:, None], (0, slot, 0, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(
         cache.v, new_v[:, None], (0, slot, 0, 0, 0))
-
-    last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)  # [1,1,H]
-    logits = _logits(cfg, params, last)[:, 0]                       # [1, V]
     return KVCache(k_cache, v_cache), logits
 
 

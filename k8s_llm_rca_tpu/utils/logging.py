@@ -48,7 +48,12 @@ class Metrics:
         finally:
             self.timings[name].append(time.perf_counter() - t0)
 
+    def count(self, name: str) -> float:
+        """Current value of an ``inc`` counter (0 if never incremented)."""
+        return self.counters.get(name, 0.0)
+
     def total(self, name: str) -> float:
+        """Summed duration of a ``timer`` phase (0 if never timed)."""
         return sum(self.timings.get(name, []))
 
     def p50(self, name: str) -> float:
