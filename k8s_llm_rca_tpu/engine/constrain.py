@@ -1,0 +1,436 @@
+"""Grammar-constrained decoding: token-level FSMs applied as logit masks.
+
+The reference extracts fenced ```json / ```cypher blocks with naive
+``str.split`` and, when the model misformats, pushes the exception text back
+into the thread and retries up to 3 times (reference
+find_metapath/find_srckind_metapath_neo4j.py:193-196, test_all.py:70-83).
+The serve layer already forces the fences themselves (forced_prefix / stop
+strings, serve/backend.py); this module closes the remaining hole — the body
+between the fences — with a character-level **JSON pushdown automaton**
+lifted to token masks, so a run requested with ``grammar="json"`` cannot
+emit unparseable JSON at all.  That converts the reference's retry loop
+from a runtime recovery path into dead code.
+
+Division of labor with the jitted decode path (SURVEY §7 hard part 4 —
+"constrained decode that stays on the fast decode path"):
+
+- the model forward + sampling stay compiled on device; the FSM runs on the
+  host between ticks (the engines already sync one [B] token vector per
+  tick, so the FSM adds no extra device round-trips);
+- a *forced* token (e.g. EOS once the JSON value closes) costs nothing on
+  device: the host overrides the sampled token before it feeds the next
+  decode step — the overridden token is what gets written to the KV cache,
+  because caches are written by the *next* tick's decode step;
+- a *masked* step ships one [B, V] bool array to the device where
+  ``sample_tokens_masked`` adds it to the logits — one small transfer, no
+  recompilation (the mask is a traced argument).
+
+Token→mask computation simulates each candidate token's characters through
+a clone of the automaton.  For the 512-entry byte tokenizer this is
+microseconds; for 32k+ BPE vocabs the per-token strings are precomputed
+once and cached per tokenizer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
+
+WS = " \t\n\r"
+DIGITS = "0123456789"
+HEX = DIGITS + "abcdefABCDEF"
+# characters legal inside a JSON string (unescaped): anything above 0x1f
+# except '"' and '\\'; we additionally exclude non-ASCII bytes so byte-level
+# tokenizers can't split a multi-byte codepoint across a mask boundary
+_STRING_CHARS = "".join(
+    chr(c) for c in range(0x20, 0x7F) if chr(c) not in '"\\')
+_ESCAPABLE = '"\\/bfnrtu'
+
+
+@dataclass(frozen=True)
+class Constraint:
+    """What the FSM demands of the next token.
+
+    ``force``: exact token id the engine must emit (no sampling).
+    ``allow``: bool [V] mask of permitted token ids (sample under mask).
+    Both ``None`` means the step is unconstrained.
+    """
+
+    force: Optional[int] = None
+    allow: Optional[np.ndarray] = None
+
+    @property
+    def free(self) -> bool:
+        return self.force is None and self.allow is None
+
+
+class JsonCharAutomaton:
+    """Incremental character-level validator for a single JSON value.
+
+    ``accept(ch)`` consumes one character, returning False (and leaving the
+    state unchanged) if it is not a legal continuation.  ``complete`` flips
+    once a full top-level value has been consumed.  ``can_terminate`` also
+    covers top-level numbers, which only end at end-of-input.
+    """
+
+    __slots__ = ("stack", "state", "lit", "lit_pos", "hex_left", "complete")
+
+    def __init__(self):
+        self.stack: List[str] = []       # 'obj' | 'arr'
+        self.state = "value"
+        self.lit = ""                    # target literal (true/false/null)
+        self.lit_pos = 0
+        self.hex_left = 0                # remaining \uXXXX hex digits
+        self.complete = False
+
+    def clone(self) -> "JsonCharAutomaton":
+        c = JsonCharAutomaton.__new__(JsonCharAutomaton)
+        c.stack = list(self.stack)
+        c.state = self.state
+        c.lit = self.lit
+        c.lit_pos = self.lit_pos
+        c.hex_left = self.hex_left
+        c.complete = self.complete
+        return c
+
+    # ------------------------------------------------------------ helpers
+
+    def _end_value(self) -> None:
+        """A value just finished; decide what comes next."""
+        if not self.stack:
+            self.complete = True
+            self.state = "trailing"
+        else:
+            self.state = "after_value"
+
+    def _delimiters(self) -> str:
+        """Characters that may legally follow a just-finished value."""
+        if not self.stack:
+            return WS
+        return WS + (",}" if self.stack[-1] == "obj" else ",]")
+
+    @property
+    def can_terminate(self) -> bool:
+        """True if end-of-input here yields a complete valid JSON value."""
+        return self.complete or (
+            not self.stack
+            and self.state in ("num_zero", "num_int", "num_frac", "num_exp"))
+
+    # ------------------------------------------------------------ accept
+
+    def accept(self, ch: str) -> bool:  # noqa: C901 (it's a flat automaton)
+        s = self.state
+        if s in ("value", "arr_value"):
+            if ch in WS:
+                return True
+            if ch == "{":
+                self.stack.append("obj")
+                self.state = "obj_key_or_end"
+            elif ch == "[":
+                self.stack.append("arr")
+                self.state = "arr_value_or_end"
+            elif ch == '"':
+                self.state = "str"
+            elif ch == "-":
+                self.state = "num_minus"
+            elif ch == "0":
+                self.state = "num_zero"
+            elif ch in "123456789":
+                self.state = "num_int"
+            elif ch in "tfn":
+                self.lit = {"t": "true", "f": "false", "n": "null"}[ch]
+                self.lit_pos = 1
+                self.state = "lit"
+            else:
+                return False
+            return True
+
+        if s == "arr_value_or_end":
+            if ch in WS:
+                return True              # stay: '[  ]' is still closable
+            if ch == "]":
+                self.stack.pop()
+                self._end_value()
+                return True
+            self.state = "value"
+            ok = self.accept(ch)
+            if not ok:
+                self.state = "arr_value_or_end"
+            return ok
+
+        if s == "obj_key_or_end":
+            if ch in WS:
+                return True
+            if ch == "}":
+                self.stack.pop()
+                self._end_value()
+                return True
+            if ch == '"':
+                self.state = "key"
+                return True
+            return False
+
+        if s == "obj_key":
+            if ch in WS:
+                return True
+            if ch == '"':
+                self.state = "key"
+                return True
+            return False
+
+        if s in ("str", "key"):
+            if ch == '"':
+                self.state = "colon" if s == "key" else None
+                if s == "str":
+                    self._end_value()
+                return True
+            if ch == "\\":
+                self.state = "str_esc" if s == "str" else "key_esc"
+                return True
+            return ch in _STRING_CHARS
+
+        if s in ("str_esc", "key_esc"):
+            base = "str" if s == "str_esc" else "key"
+            if ch == "u":
+                self.hex_left = 4
+                self.state = base + "_hex"
+                return True
+            if ch in _ESCAPABLE:
+                self.state = base
+                return True
+            return False
+
+        if s in ("str_hex", "key_hex"):
+            if ch in HEX:
+                self.hex_left -= 1
+                if self.hex_left == 0:
+                    self.state = s[:3]
+                return True
+            return False
+
+        if s == "colon":
+            if ch in WS:
+                return True
+            if ch == ":":
+                self.state = "value"
+                return True
+            return False
+
+        if s == "after_value":
+            if ch in WS:
+                return True
+            top = self.stack[-1]
+            if ch == ",":
+                self.state = "obj_key" if top == "obj" else "value"
+                return True
+            if ch == "}" and top == "obj":
+                self.stack.pop()
+                self._end_value()
+                return True
+            if ch == "]" and top == "arr":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return False
+
+        if s == "lit":
+            if self.lit_pos < len(self.lit) and ch == self.lit[self.lit_pos]:
+                self.lit_pos += 1
+                if self.lit_pos == len(self.lit):
+                    self._end_value()
+                return True
+            return False
+
+        # ---- numbers: strict JSON grammar; they end on a delimiter, which
+        # must then be re-dispatched through the post-value state
+        if s in ("num_minus", "num_zero", "num_int",
+                 "num_frac_start", "num_frac",
+                 "num_exp_start", "num_exp_sign", "num_exp"):
+            return self._accept_number(s, ch)
+
+        if s == "trailing":
+            return ch in WS
+
+        raise AssertionError(f"unknown state {s}")
+
+    def _closing_char(self) -> str:
+        """One character moving toward the shortest valid completion."""
+        s = self.state
+        if s in ("value", "arr_value", "num_minus", "num_frac_start",
+                 "num_exp_start", "num_exp_sign", "str_hex", "key_hex"):
+            return "0"
+        if s == "arr_value_or_end":
+            return "]"
+        if s == "obj_key_or_end":
+            return "}"
+        if s in ("obj_key", "str", "key"):
+            return '"'
+        if s in ("str_esc", "key_esc"):
+            return "n"
+        if s == "colon":
+            return ":"
+        if s == "after_value":
+            return "}" if self.stack[-1] == "obj" else "]"
+        if s == "lit":
+            return self.lit[self.lit_pos]
+        if s in ("num_zero", "num_int", "num_frac", "num_exp"):
+            # number ends at the enclosing delimiter (top-level: end-of-input)
+            return "}" if self.stack[-1] == "obj" else "]"
+        raise AssertionError(f"no closing char for state {s}")
+
+    def minimal_completion(self) -> str:
+        """Shortest character string that completes a valid JSON value from
+        the current state ('' if already complete / terminable)."""
+        clone = self.clone()
+        out = []
+        while not clone.complete and not clone.can_terminate:
+            ch = clone._closing_char()
+            assert clone.accept(ch), (clone.state, ch)
+            out.append(ch)
+        return "".join(out)
+
+    def _accept_number(self, s: str, ch: str) -> bool:
+        cont = {
+            "num_minus": {"0": "num_zero", **{d: "num_int" for d in "123456789"}},
+            "num_zero": {".": "num_frac_start", "e": "num_exp_start",
+                         "E": "num_exp_start"},
+            "num_int": {**{d: "num_int" for d in DIGITS},
+                        ".": "num_frac_start", "e": "num_exp_start",
+                        "E": "num_exp_start"},
+            "num_frac_start": {d: "num_frac" for d in DIGITS},
+            "num_frac": {**{d: "num_frac" for d in DIGITS},
+                         "e": "num_exp_start", "E": "num_exp_start"},
+            "num_exp_start": {"+": "num_exp_sign", "-": "num_exp_sign",
+                              **{d: "num_exp" for d in DIGITS}},
+            "num_exp_sign": {d: "num_exp" for d in DIGITS},
+            "num_exp": {d: "num_exp" for d in DIGITS},
+        }[s]
+        nxt = cont.get(ch)
+        if nxt is not None:
+            self.state = nxt
+            return True
+        # a complete number form may end at a delimiter of the enclosing
+        # container; incomplete forms (num_minus, num_frac_start, ...) may not
+        if s in ("num_zero", "num_int", "num_frac", "num_exp") and \
+                ch in self._delimiters():
+            self._end_value()
+            if ch in WS:
+                return True
+            return self.accept(ch)   # re-dispatch ',' '}' ']'
+        return False
+
+
+def _token_strings(tokenizer: Tokenizer) -> List[str]:
+    """Per-token decoded strings, cached ON the tokenizer instance (an
+    id()-keyed module cache would leak tables and could serve a stale
+    table after CPython address reuse)."""
+    cached = getattr(tokenizer, "_token_strings_cache", None)
+    if cached is None:
+        cached = [tokenizer.decode([t]) for t in range(tokenizer.vocab_size)]
+        tokenizer._token_strings_cache = cached
+    return cached
+
+
+class JsonGrammar:
+    """Token-level FSM guaranteeing the generated body parses as JSON.
+
+    Constraint per step: mask to tokens whose every character the automaton
+    accepts; once the top-level value is complete (or a top-level number can
+    terminate and the sampled token would be trailing junk), force EOS.
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self.tokenizer = tokenizer
+        self.auto = JsonCharAutomaton()
+        self.eos_id = tokenizer.eos_id
+        self._strings = _token_strings(tokenizer)
+        self._mask_cache: Dict[Tuple, np.ndarray] = {}
+        # exact single-character token ids for the force-close path (encode()
+        # round trips are not identity for SentencePiece-style tokenizers)
+        self._char_token: Dict[str, int] = {}
+        max_chars = 1
+        for t, s in enumerate(self._strings):
+            if len(s) == 1 and s not in self._char_token:
+                self._char_token[s] = t
+            max_chars = max(max_chars, len(s))
+        # one sampled token can extend the minimal completion by a few chars
+        # per character it contains (an opening brace adds a closer, a key
+        # quote adds '":0', ...), while force-close emits one char per tick —
+        # so multi-char vocabs must start closing earlier
+        self._close_margin = 2 + 4 * (max_chars - 1)
+
+    @property
+    def done(self) -> bool:
+        return self.auto.complete
+
+    def _state_key(self) -> Tuple:
+        a = self.auto
+        return (tuple(a.stack), a.state, a.lit, a.lit_pos, a.hex_left)
+
+    def constraint(self, remaining: Optional[int] = None) -> Constraint:
+        """``remaining``: token budget left for this sequence.  When it
+        shrinks to the minimal-completion length (+2 safety margin, 1 token
+        per char worst case), the FSM stops sampling and force-closes the
+        value so a "length"-terminated sequence still parses."""
+        if self.auto.complete:
+            return Constraint(force=self.eos_id)
+        if remaining is not None:
+            completion = self.auto.minimal_completion()
+            if remaining <= len(completion) + self._close_margin:
+                if not completion:
+                    return Constraint(force=self.eos_id)
+                forced = self._char_token.get(completion[0])
+                if forced is None:
+                    # vocab has no exact single-char token for the closer
+                    # (never the case for byte vocabs): end cleanly if the
+                    # value can terminate, else emit what encode() gives
+                    if self.auto.can_terminate:
+                        return Constraint(force=self.eos_id)
+                    forced = self.tokenizer.encode(completion[0])[0]
+                return Constraint(force=forced)
+        key = self._state_key()
+        allow = self._mask_cache.get(key)
+        if allow is None:
+            allow = np.zeros((self.tokenizer.vocab_size,), bool)
+            for t, s in enumerate(self._strings):
+                if not s:
+                    continue            # specials / empty decodes: never legal
+                if all(c in WS for c in s):
+                    # JSON never REQUIRES whitespace; banning pure-ws tokens
+                    # keeps output compact instead of letting a weak model
+                    # burn its budget emitting newlines
+                    continue
+                sim = self.auto.clone()
+                if all(sim.accept(c) for c in s):
+                    allow[t] = True
+            if self.auto.can_terminate:
+                allow[self.eos_id] = True
+            self._mask_cache[key] = allow
+        if not allow.any():
+            # un-continuable (shouldn't happen with a byte vocab): end the
+            # sequence rather than decode garbage forever
+            return Constraint(force=self.eos_id)
+        return Constraint(allow=allow)
+
+    def advance(self, token: int) -> None:
+        if token == self.eos_id:
+            return
+        for ch in self._strings[token]:
+            if not self.auto.accept(ch):
+                raise ValueError(
+                    f"token {token} ({self._strings[token]!r}) violates the "
+                    f"JSON grammar in state {self.auto.state}")
+
+
+def make_grammar(name: Optional[str],
+                 tokenizer: Tokenizer) -> Optional[JsonGrammar]:
+    """GenOptions.grammar -> FSM instance (None = unconstrained)."""
+    if name is None:
+        return None
+    if name == "json":
+        return JsonGrammar(tokenizer)
+    raise ValueError(f"unknown grammar {name!r} (supported: 'json')")

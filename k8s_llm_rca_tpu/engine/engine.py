@@ -33,7 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
-from k8s_llm_rca_tpu.engine.sampling import SamplingParams, sample_tokens
+from k8s_llm_rca_tpu.engine.sampling import (
+    SamplingParams, sample_tokens, sample_tokens_masked,
+)
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
@@ -59,6 +61,7 @@ class _Active:
     generated: List[int] = field(default_factory=list)
     max_new_tokens: int = 256
     stop_strings: Tuple[str, ...] = ()
+    grammar: Optional[object] = None    # engine/constrain.py FSM (stateful)
 
 
 @dataclass
@@ -67,6 +70,7 @@ class _Pending:
     prompt_ids: List[int]
     max_new_tokens: int
     stop_strings: Tuple[str, ...]
+    grammar: Optional[object] = None
 
 
 class EngineBase:
@@ -122,17 +126,69 @@ class EngineBase:
         prompt_ids: Sequence[int],
         max_new_tokens: Optional[int] = None,
         stop_strings: Sequence[str] = (),
+        grammar: Optional[object] = None,
     ) -> int:
-        """Queue a sequence; returns its seq_id.  Non-blocking."""
+        """Queue a sequence; returns its seq_id.  Non-blocking.
+
+        ``grammar``: optional constrain.py FSM owned by this sequence; the
+        engine consults it every tick (forced tokens / logit masks)."""
         seq_id = next(self._seq_counter)
         prompt_ids, max_new = self._clamp_prompt(prompt_ids, max_new_tokens)
         self._register(seq_id, prompt_ids)
         self._pending.append(
-            _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings)))
+            _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings),
+                     grammar))
         return seq_id
 
     def _register(self, seq_id: int, prompt_ids: List[int]) -> None:
         """Subclass hook called once per submitted sequence."""
+
+    # ------------------------------------------------ grammar application
+
+    def _grammar_first_token(self, grammar, logits, sampled: int,
+                             remaining: int) -> int:
+        """Constrain the first post-prefill token.  Resampling goes through
+        the same device sampler as every later token (identical
+        temperature/top-k/top-p semantics); admission is per-sequence, so
+        the extra [1, V] sample costs one small dispatch once per
+        sequence."""
+        c = grammar.constraint(remaining)
+        if c.force is not None:
+            return c.force
+        if c.allow is not None and not bool(c.allow[sampled]):
+            self._key, sub = jax.random.split(self._key)
+            masked = self._sample_masked(
+                logits, sub, self.sampling, jnp.asarray(c.allow[None]))
+            return int(masked[0])
+        return sampled
+
+    def _budget_remaining(self, st: _Active) -> int:
+        """Tokens this sequence can still emit: min of its max_new budget
+        and the cache capacity left (both can trigger 'length').  Pure host
+        arithmetic — prompt_tokens + generated tracks the device length
+        (one behind mid-tick, which only closes the grammar one token
+        early)."""
+        cache_room = (self.engine_cfg.max_seq_len
+                      - (st.prompt_tokens + len(st.generated)) - 1)
+        return min(st.max_new_tokens - len(st.generated), cache_room)
+
+    def _tick_constraints(self, active_slots, n_slots: int, vocab: int):
+        """Collect per-slot constraints for this tick.  Returns
+        (forced {slot: token}, allow [B, V] bool or None)."""
+        forced = {}
+        allow = None
+        for slot in active_slots:
+            st = self._active[slot]
+            if st.grammar is None:
+                continue
+            c = st.grammar.constraint(self._budget_remaining(st))
+            if c.force is not None:
+                forced[slot] = c.force
+            elif c.allow is not None:
+                if allow is None:
+                    allow = np.ones((n_slots, vocab), bool)
+                allow[slot] = c.allow
+        return forced, allow
 
     def step(self) -> List[SequenceResult]:
         raise NotImplementedError
@@ -231,6 +287,7 @@ class InferenceEngine(EngineBase):
         self._prefill = jax.jit(llama.prefill, static_argnums=0)
         self._decode = jax.jit(llama.decode_step, static_argnums=0)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
+        self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
 
         self._buckets = tuple(
             s for s in sorted(set(engine_cfg.prefill_buckets))
@@ -250,24 +307,40 @@ class InferenceEngine(EngineBase):
         if not self._active:
             return finished
 
+        active_slots = list(self._active)
+        forced, allow = self._tick_constraints(
+            active_slots, self.engine_cfg.max_batch,
+            self.model_cfg.vocab_size)
         with METRICS.timer("engine.decode_step"):
             self.cache, logits = self._decode(
                 self.model_cfg, self.params, self.cache,
                 self.cur_tokens, self.lengths)
             self._key, sub = jax.random.split(self._key)
-            next_tokens = self._sample(logits, sub, self.sampling)
+            if allow is not None:
+                next_tokens = self._sample_masked(
+                    logits, sub, self.sampling, jnp.asarray(allow))
+            else:
+                next_tokens = self._sample(logits, sub, self.sampling)
         METRICS.inc("engine.decode_tokens", len(self._active))
 
-        active_slots = list(self._active)
         self.lengths = self.lengths.at[jnp.asarray(active_slots)].add(1)
-        self.cur_tokens = next_tokens
-        host_next = np.asarray(next_tokens)
+        if forced:
+            # np.asarray of a device array is a read-only view; copy to edit
+            host_next = np.asarray(next_tokens).copy()
+            for slot, token in forced.items():
+                host_next[slot] = token
+            self.cur_tokens = jnp.asarray(host_next)
+        else:
+            host_next = np.asarray(next_tokens)
+            self.cur_tokens = next_tokens
         lengths_host = np.asarray(self.lengths)
 
         for slot in active_slots:
             st = self._active[slot]
             token = int(host_next[slot])
             st.generated.append(token)
+            if st.grammar is not None:
+                st.grammar.advance(token)
             reason = self._finish_reason(st, token, int(lengths_host[slot]))
             if reason is not None:
                 finished.append(self._retire(slot, reason))
@@ -298,8 +371,15 @@ class InferenceEngine(EngineBase):
 
         st = _Active(
             seq_id=req.seq_id, slot=slot, prompt_tokens=n,
-            max_new_tokens=req.max_new_tokens, stop_strings=req.stop_strings)
+            max_new_tokens=req.max_new_tokens, stop_strings=req.stop_strings,
+            grammar=req.grammar)
         token = int(first[0])
+        if st.grammar is not None:
+            remaining = min(st.max_new_tokens,
+                            self.engine_cfg.max_seq_len - n - 1)
+            token = self._grammar_first_token(st.grammar, logits, token,
+                                              remaining)
+            st.grammar.advance(token)
         st.generated.append(token)
         self._active[slot] = st
         self.lengths = self.lengths.at[slot].set(n)

@@ -34,7 +34,9 @@ from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
 from k8s_llm_rca_tpu.engine.engine import (
     EngineBase, SequenceResult, _Active, _Pending,
 )
-from k8s_llm_rca_tpu.engine.sampling import SamplingParams, sample_tokens
+from k8s_llm_rca_tpu.engine.sampling import (
+    SamplingParams, sample_tokens, sample_tokens_masked,
+)
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.paged_attention import (
@@ -271,6 +273,7 @@ class PagedInferenceEngine(EngineBase):
             paged_decode_step, static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._sample = jax.jit(sample_tokens, static_argnums=2)
+        self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
 
         self._buckets = tuple(
             s for s in sorted(set(engine_cfg.prefill_buckets))
@@ -325,6 +328,9 @@ class PagedInferenceEngine(EngineBase):
         if not active_slots:
             return finished
 
+        forced, allow = self._tick_constraints(
+            active_slots, self.engine_cfg.max_batch,
+            self.model_cfg.vocab_size)
         with METRICS.timer("engine.decode_step"):
             self.k_pages, self.v_pages, logits = self._decode(
                 self.model_cfg, self.params, self.k_pages, self.v_pages,
@@ -333,16 +339,22 @@ class PagedInferenceEngine(EngineBase):
                 jnp.asarray(self.block_tables),
                 use_kernel=self.use_kernel)
             self._key, sub = jax.random.split(self._key)
-            next_tokens = self._sample(logits, sub, self.sampling)
+            if allow is not None:
+                next_tokens = self._sample_masked(
+                    logits, sub, self.sampling, jnp.asarray(allow))
+            else:
+                next_tokens = self._sample(logits, sub, self.sampling)
         METRICS.inc("engine.decode_tokens", len(active_slots))
 
         host_next = np.asarray(next_tokens)
         for slot in active_slots:
             self.lengths[slot] += 1
             st = self._active[slot]
-            token = int(host_next[slot])
+            token = forced.get(slot, int(host_next[slot]))
             self.cur_tokens[slot] = token
             st.generated.append(token)
+            if st.grammar is not None:
+                st.grammar.advance(token)
             reason = self._finish_reason(st, token, int(self.lengths[slot]))
             if reason is not None:
                 finished.append(self._retire(slot, reason))
@@ -380,8 +392,14 @@ class PagedInferenceEngine(EngineBase):
 
         st = _Active(seq_id=req.seq_id, slot=slot, prompt_tokens=n,
                      max_new_tokens=req.max_new_tokens,
-                     stop_strings=req.stop_strings)
+                     stop_strings=req.stop_strings, grammar=req.grammar)
         token = int(first[0])
+        if st.grammar is not None:
+            remaining = min(st.max_new_tokens,
+                            self.engine_cfg.max_seq_len - n - 1)
+            token = self._grammar_first_token(st.grammar, logits, token,
+                                              remaining)
+            st.grammar.advance(token)
         st.generated.append(token)
         self._active[slot] = st
         self.lengths[slot] = n
@@ -428,8 +446,11 @@ class PagedInferenceEngine(EngineBase):
         log.info("preempting seq %d (slot %d, %d tokens) to free pages",
                  st.seq_id, slot, len(resumed_prompt))
         METRICS.inc("engine.preemptions", 1)
+        # the grammar FSM rides along: its state already reflects every
+        # generated token now baked into the resume prompt
         self._pending.insert(0, _Pending(
-            st.seq_id, resumed_prompt, remaining, st.stop_strings))
+            st.seq_id, resumed_prompt, remaining, st.stop_strings,
+            st.grammar))
 
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)

@@ -58,3 +58,15 @@ def sample_tokens(
     if params.top_p < 1.0:
         scaled = _apply_top_p(scaled, params.top_p)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def sample_tokens_masked(
+    logits: jnp.ndarray,        # [B, V] fp32
+    key: jax.Array,
+    params: SamplingParams,
+    allow: jnp.ndarray,         # [B, V] bool; True = token permitted
+) -> jnp.ndarray:
+    """Grammar-constrained variant: disallowed tokens are masked to -inf
+    BEFORE top-k/top-p, so the renormalized distribution stays inside the
+    grammar (engine/constrain.py builds the masks)."""
+    return sample_tokens(jnp.where(allow, logits, NEG_INF), key, params)

@@ -50,7 +50,7 @@ def setup_root_cause_locator(service: AssistantService,
         LOCATOR_INSTRUCTIONS, "k8s-root-cause-locator", model,
         gen=GenOptions(max_new_tokens=max_new_tokens,
                        forced_prefix="```json\n", stop=("```",),
-                       suffix="\n```"))
+                       suffix="\n```", grammar="json"))
     locator.create_thread()
     return locator
 

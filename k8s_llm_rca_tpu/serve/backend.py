@@ -21,6 +21,7 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Protocol, Tuple
 
+from k8s_llm_rca_tpu.engine.constrain import make_grammar
 from k8s_llm_rca_tpu.engine.engine import InferenceEngine
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
@@ -31,6 +32,10 @@ class GenOptions:
     stop: Tuple[str, ...] = ()
     forced_prefix: str = ""     # emitted verbatim, prefilled as forced tokens
     suffix: str = ""            # appended verbatim after generation stops
+    # grammar-constrained decode of the BODY (engine/constrain.py): "json"
+    # guarantees the generated text parses; composes with forced_prefix /
+    # suffix carrying the fences.  None = unconstrained.
+    grammar: Optional[str] = None
 
 
 @dataclass
@@ -63,8 +68,15 @@ class EngineBackend:
     def start(self, prompt: str, opts: GenOptions) -> int:
         handle = next(self._handles)
         ids = self.tokenizer.encode(prompt + opts.forced_prefix, add_bos=True)
+        grammar = make_grammar(opts.grammar, self.tokenizer)
+        # a grammar owns termination (forced EOS when the value closes);
+        # stop strings must not also apply — e.g. "```" is a legal substring
+        # INSIDE a JSON string, and a stop match there would truncate the
+        # body mid-string and break the parse guarantee
+        stop = () if grammar is not None else opts.stop
         seq_id = self.engine.submit(
-            ids, max_new_tokens=opts.max_new_tokens, stop_strings=opts.stop)
+            ids, max_new_tokens=opts.max_new_tokens, stop_strings=stop,
+            grammar=grammar)
         self._seq_to_handle[seq_id] = handle
         self._opts[handle] = opts
         self._live[handle] = True

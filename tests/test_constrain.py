@@ -1,0 +1,171 @@
+"""Grammar-constrained decoding tests.
+
+The decisive property: a RANDOM-weight model decoding under the JSON
+grammar must always produce ``json.loads``-able output — greedy or
+stochastic, contiguous or paged engine, even when the token budget runs
+out mid-structure (budget-aware force-close) or the sequence is preempted
+and resumed.  This is what turns the reference's JSONDecodeError
+retry-with-feedback loop (reference test_all.py:70-83) into dead code.
+"""
+
+import json
+
+import jax
+import pytest
+
+from k8s_llm_rca_tpu.config import TINY, EngineConfig
+from k8s_llm_rca_tpu.engine.constrain import (
+    JsonCharAutomaton, JsonGrammar, make_grammar,
+)
+from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+
+def feed(text):
+    a = JsonCharAutomaton()
+    for ch in text:
+        if not a.accept(ch):
+            return None
+    return a
+
+
+class TestJsonCharAutomaton:
+    @pytest.mark.parametrize("text", [
+        '{}', '[]', '"hi"', 'true', 'false', 'null', '0', '-12.5e+3',
+        '{"a": 1}', '{"a": [1, 2, {"b": null}], "c": "x\\n"}',
+        '[", \\" {] [", -0.5]', '{"u": "\\u00e9"}', '  { "k" : [ ] } ',
+        '{"": 0}',
+    ])
+    def test_accepts_valid(self, text):
+        a = feed(text)
+        assert a is not None and a.can_terminate
+        json.loads(text)   # sanity: stdlib agrees
+
+    @pytest.mark.parametrize("text", [
+        '{', '{"a" 1}', '{"a": 1,}', '[1 2]', '01', '1.', '1e', '--1',
+        'tru', '{"a": }', '}', '"\\x"', '{"a": "b",}', '[1,]', 'nul ',
+    ])
+    def test_rejects_or_incomplete(self, text):
+        a = feed(text)
+        # either a character was rejected, or the value cannot end here
+        assert a is None or not a.can_terminate
+
+    def test_trailing_junk_rejected(self):
+        a = feed('{"a": 1}')
+        assert a.complete
+        assert not a.accept('x')
+        assert a.accept(' ')       # trailing whitespace is fine
+
+    @pytest.mark.parametrize("prefix", [
+        '', '{', '{"key', '{"key": ', '{"a": [1, {"b": "x', '-1.2e',
+        '{"a": tr', '{"s": "esc\\',
+    ])
+    def test_minimal_completion_closes_any_prefix(self, prefix):
+        a = feed(prefix)
+        assert a is not None, prefix
+        completion = a.minimal_completion()
+        done = feed(prefix + completion)
+        assert done is not None and done.can_terminate
+        if prefix + completion:
+            json.loads(prefix + completion)
+
+
+class TestConstrainedEngine:
+    def _engine(self, paged=False, **ecfg_kw):
+        cfg = TINY.replace(max_seq_len=256)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        defaults = dict(max_batch=4, max_seq_len=128, max_new_tokens=48,
+                        prefill_buckets=(32, 64), temperature=0.0)
+        defaults.update(ecfg_kw)
+        ecfg = EngineConfig(**defaults)
+        tok = get_tokenizer()
+        if paged:
+            eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                       use_kernel=False)
+        else:
+            eng = InferenceEngine(cfg, ecfg, params, tok)
+        return eng, tok
+
+    def _run(self, eng, tok, prompts, **kw):
+        ids = [eng.submit(tok.encode(p, add_bos=True),
+                          grammar=JsonGrammar(tok), **kw) for p in prompts]
+        results = {r.seq_id: r for r in eng.run_to_completion()}
+        return [results[i] for i in ids]
+
+    def test_greedy_random_model_emits_valid_json(self):
+        eng, tok = self._engine()
+        outs = self._run(eng, tok, ["report the incident as json",
+                                    "another prompt entirely"])
+        for r in outs:
+            parsed = json.loads(r.text)   # must not raise
+            assert parsed is not None or parsed is None  # any JSON value
+
+    def test_stochastic_sampling_stays_in_grammar(self):
+        eng, tok = self._engine(temperature=1.0, top_k=40)
+        outs = self._run(eng, tok, ["a", "b", "c", "d"])
+        for r in outs:
+            json.loads(r.text)
+
+    def test_budget_exhaustion_force_closes(self):
+        # tiny budget: the FSM must close whatever structure it opened
+        eng, tok = self._engine(temperature=1.0)
+        outs = self._run(eng, tok, ["x", "y"], max_new_tokens=7)
+        for r in outs:
+            json.loads(r.text)
+            assert len(r.token_ids) <= 7
+
+    def test_paged_engine_with_preemption_keeps_grammar(self):
+        # tight pool forces growth-path preemption mid-generation; the FSM
+        # must survive the requeue/resume cycle
+        eng, tok = self._engine(paged=True, max_batch=3, max_seq_len=64,
+                                page_size=8, num_pages=12,
+                                prefill_buckets=(16,), temperature=1.0)
+        outs = self._run(eng, tok, ["aaaaaaaaaaaa", "bbbbbbbbbbbb",
+                                    "cccccccccccc"], max_new_tokens=24)
+        assert len(outs) == 3
+        for r in outs:
+            json.loads(r.text)
+        eng.allocator.check()
+
+    def test_eos_finish_reason_and_no_trailing_garbage(self):
+        eng, tok = self._engine()
+        (r,) = self._run(eng, tok, ["emit json"])
+        assert r.finish_reason in ("eos", "length")
+        # json.loads only succeeds if the ENTIRE text is one JSON value
+        # (plus whitespace) — parsing is itself the no-trailing-junk proof
+        json.loads(r.text)
+
+
+class TestBackendIntegration:
+    def test_gen_options_grammar_roundtrip(self):
+        from k8s_llm_rca_tpu.serve.api import AssistantService
+        from k8s_llm_rca_tpu.serve.backend import EngineBackend, GenOptions
+
+        cfg = TINY.replace(max_seq_len=256)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch=2, max_seq_len=128, max_new_tokens=32,
+                            prefill_buckets=(32, 64))
+        tok = get_tokenizer()
+        backend = EngineBackend(InferenceEngine(cfg, ecfg, params, tok))
+        service = AssistantService(backend)
+        asst = service.create_assistant(
+            "emit json", "t", "m",
+            gen=GenOptions(max_new_tokens=32, forced_prefix="```json\n",
+                           suffix="\n```", grammar="json"))
+        th = service.create_thread()
+        service.add_message(th.id, "incident: pod failed")
+        run = service.create_run(th.id, asst.id)
+        run = service.wait_run(run.id)
+        assert run.status == "completed"
+        text = service.list_messages(th.id, limit=1).data[0] \
+            .content[0].text.value
+        assert text.startswith("```json\n") and text.endswith("\n```")
+        body = text[len("```json\n"):-len("\n```")]
+        json.loads(body)
+
+    def test_make_grammar_rejects_unknown(self):
+        with pytest.raises(ValueError):
+            make_grammar("yaml", get_tokenizer())
+        assert make_grammar(None, get_tokenizer()) is None
