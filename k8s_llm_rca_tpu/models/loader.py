@@ -1,0 +1,260 @@
+"""Checkpoint loading: HF safetensors -> framework param pytrees.
+
+The reference has no model weights at all (its only "model" is the remote
+GPT-4 endpoint, reference common/openai_generic_assistant.py:45-51); this
+module is what makes the in-tree engine real: it maps public HuggingFace
+checkpoints (TinyLlama-1.1B, Llama-3-8B, Mixtral-8x7B, e5-large) onto the
+pytrees of models/llama.py and models/encoder.py.
+
+The safetensors reader/writer is self-contained (the format is an 8-byte
+little-endian header length, a JSON header with dtype/shape/data_offsets
+per tensor, then one flat byte buffer) so the hermetic test path needs no
+optional dependency and zero network access.  Sharded checkpoints load
+through ``model.safetensors.index.json``.
+
+Conventions:
+- HF ``nn.Linear`` stores weight as [out, in]; our matmuls are x @ W with
+  W [in, out], so every projection transposes on load.
+- Rotary embeddings: HF Llama checkpoints use the rotate-half (NeoX)
+  layout, which is exactly ops/rope.py's convention — q/k load untransformed.
+- All tensors cast to ``cfg.dtype`` (bf16 on TPU) except where noted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from k8s_llm_rca_tpu.config import EncoderConfig, ModelConfig
+
+Params = Dict[str, Any]
+
+_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "BF16": ml_dtypes.bfloat16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+_DTYPE_NAMES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+# ---------------------------------------------------------------------------
+# safetensors file format
+# ---------------------------------------------------------------------------
+
+
+def read_safetensors(path: str) -> Dict[str, np.ndarray]:
+    """Read one .safetensors file into name -> np.ndarray.
+
+    Tensors are copied out of the file buffer (frombuffer views would pin
+    the whole shard's raw bytes for as long as ANY tensor lives, tripling
+    peak host memory on multi-shard 8x7B loads); the buffer is released
+    when this returns."""
+    with open(path, "rb") as f:
+        (header_len,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(header_len))
+        buf = f.read()
+    out: Dict[str, np.ndarray] = {}
+    for name, info in header.items():
+        if name == "__metadata__":
+            continue
+        start, end = info["data_offsets"]
+        arr = np.frombuffer(buf[start:end], dtype=_DTYPES[info["dtype"]])
+        out[name] = np.array(arr.reshape(info["shape"]))
+    return out
+
+
+def write_safetensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
+    """Write name -> array as a .safetensors file (tests, export)."""
+    header: Dict[str, Any] = {}
+    blobs: List[bytes] = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        blob = arr.tobytes()
+        header[name] = {
+            "dtype": _DTYPE_NAMES[arr.dtype],
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        blobs.append(blob)
+        offset += len(blob)
+    head = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(head)))
+        f.write(head)
+        for blob in blobs:
+            f.write(blob)
+
+
+def load_checkpoint_tensors(path: str) -> Dict[str, np.ndarray]:
+    """Load a checkpoint: a single .safetensors file, or an HF model dir
+    (single ``model.safetensors`` or sharded via
+    ``model.safetensors.index.json``)."""
+    if os.path.isfile(path):
+        return read_safetensors(path)
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map: Dict[str, str] = json.load(f)["weight_map"]
+        tensors: Dict[str, np.ndarray] = {}
+        for shard in sorted(set(weight_map.values())):
+            tensors.update(read_safetensors(os.path.join(path, shard)))
+        return tensors
+    single = os.path.join(path, "model.safetensors")
+    if os.path.exists(single):
+        return read_safetensors(single)
+    raise FileNotFoundError(f"no safetensors checkpoint under {path}")
+
+
+# ---------------------------------------------------------------------------
+# HF name mapping
+# ---------------------------------------------------------------------------
+
+
+def _get(tensors: Dict[str, np.ndarray], name: str) -> np.ndarray:
+    if name not in tensors:
+        raise KeyError(
+            f"checkpoint is missing {name!r} "
+            f"(has {len(tensors)} tensors, e.g. {sorted(tensors)[:4]})")
+    return tensors[name]
+
+
+def _take(tensors: Dict[str, np.ndarray], name: str) -> np.ndarray:
+    """_get + pop: host memory shrinks as device params are built, so the
+    host copy and the device copy of the full model never coexist."""
+    arr = _get(tensors, name)
+    del tensors[name]
+    return arr
+
+
+def _cast(arr: np.ndarray, dtype) -> jnp.ndarray:
+    return jnp.asarray(arr.astype(_np_dtype(dtype), copy=False))
+
+
+def _np_dtype(dtype) -> np.dtype:
+    d = jnp.dtype(dtype)
+    return np.dtype(ml_dtypes.bfloat16) if d == jnp.bfloat16 else np.dtype(d)
+
+
+def llama_params_from_hf(cfg: ModelConfig,
+                         tensors: Dict[str, np.ndarray]) -> Params:
+    """Map an HF Llama/TinyLlama/Mixtral state dict onto models/llama.py's
+    pytree (Mixtral when cfg.n_experts > 0)."""
+    dt = cfg.dtype
+    if cfg.tie_embeddings and "lm_head.weight" in tensors and \
+            not np.array_equal(tensors["lm_head.weight"],
+                               tensors.get("model.embed_tokens.weight")):
+        raise ValueError(
+            "checkpoint has a distinct lm_head.weight but the config ties "
+            "embeddings — loading would silently discard the output head; "
+            "use a config with tie_embeddings=False")
+    layers: List[Params] = []
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layer: Params = {
+            "attn_norm": _cast(_take(tensors, p + "input_layernorm.weight"), dt),
+            "mlp_norm": _cast(
+                _take(tensors, p + "post_attention_layernorm.weight"), dt),
+            "wq": _cast(_take(tensors, p + "self_attn.q_proj.weight").T, dt),
+            "wk": _cast(_take(tensors, p + "self_attn.k_proj.weight").T, dt),
+            "wv": _cast(_take(tensors, p + "self_attn.v_proj.weight").T, dt),
+            "wo": _cast(_take(tensors, p + "self_attn.o_proj.weight").T, dt),
+        }
+        if cfg.n_experts > 0:
+            moe = p + "block_sparse_moe."
+            layer["router"] = _cast(_take(tensors, moe + "gate.weight").T, dt)
+            gates, ups, downs = [], [], []
+            for e in range(cfg.n_experts):
+                ep = f"{moe}experts.{e}."
+                gates.append(_take(tensors, ep + "w1.weight").T)  # [H, I]
+                downs.append(_take(tensors, ep + "w2.weight").T)  # [I, H]
+                ups.append(_take(tensors, ep + "w3.weight").T)    # [H, I]
+            layer["w_gate"] = _cast(np.stack(gates), dt)          # [E, H, I]
+            layer["w_up"] = _cast(np.stack(ups), dt)
+            layer["w_down"] = _cast(np.stack(downs), dt)          # [E, I, H]
+        else:
+            layer["w_gate"] = _cast(_take(tensors, p + "mlp.gate_proj.weight").T, dt)
+            layer["w_up"] = _cast(_take(tensors, p + "mlp.up_proj.weight").T, dt)
+            layer["w_down"] = _cast(_take(tensors, p + "mlp.down_proj.weight").T, dt)
+        layers.append(layer)
+
+    params: Params = {
+        "final_norm": _cast(_get(tensors, "model.norm.weight"), dt),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        # tied checkpoints (e.g. some TinyLlama exports) omit lm_head
+        head = tensors.get("lm_head.weight",
+                           tensors["model.embed_tokens.weight"])
+        params["lm_head"] = _cast(head, dt)
+    params["embedding"] = _cast(_take(tensors, "model.embed_tokens.weight"), dt)
+    return params
+
+
+def encoder_params_from_hf(cfg: EncoderConfig,
+                           tensors: Dict[str, np.ndarray]) -> Params:
+    """Map an HF BERT-family (e5) state dict onto models/encoder.py's
+    pytree."""
+    # some exports nest everything under a "bert." module prefix
+    if ("embeddings.word_embeddings.weight" not in tensors
+            and "bert.embeddings.word_embeddings.weight" in tensors):
+        tensors = {k[len("bert."):]: v for k, v in tensors.items()
+                   if k.startswith("bert.")}
+    dt = cfg.dtype
+    layers: List[Params] = []
+    for i in range(cfg.n_layers):
+        p = f"encoder.layer.{i}."
+        layers.append({
+            "wq": _cast(_get(tensors, p + "attention.self.query.weight").T, dt),
+            "bq": _cast(_get(tensors, p + "attention.self.query.bias"), dt),
+            "wk": _cast(_get(tensors, p + "attention.self.key.weight").T, dt),
+            "bk": _cast(_get(tensors, p + "attention.self.key.bias"), dt),
+            "wv": _cast(_get(tensors, p + "attention.self.value.weight").T, dt),
+            "bv": _cast(_get(tensors, p + "attention.self.value.bias"), dt),
+            "wo": _cast(_get(tensors, p + "attention.output.dense.weight").T, dt),
+            "bo": _cast(_get(tensors, p + "attention.output.dense.bias"), dt),
+            "attn_ln_w": _cast(
+                _get(tensors, p + "attention.output.LayerNorm.weight"), dt),
+            "attn_ln_b": _cast(
+                _get(tensors, p + "attention.output.LayerNorm.bias"), dt),
+            "w_in": _cast(_get(tensors, p + "intermediate.dense.weight").T, dt),
+            "b_in": _cast(_get(tensors, p + "intermediate.dense.bias"), dt),
+            "w_out": _cast(_get(tensors, p + "output.dense.weight").T, dt),
+            "b_out": _cast(_get(tensors, p + "output.dense.bias"), dt),
+            "mlp_ln_w": _cast(_get(tensors, p + "output.LayerNorm.weight"), dt),
+            "mlp_ln_b": _cast(_get(tensors, p + "output.LayerNorm.bias"), dt),
+        })
+    return {
+        "word_embedding": _cast(
+            _get(tensors, "embeddings.word_embeddings.weight"), dt),
+        "position_embedding": _cast(
+            _get(tensors, "embeddings.position_embeddings.weight"), dt),
+        "type_embedding": _cast(
+            _get(tensors, "embeddings.token_type_embeddings.weight"), dt),
+        "embed_ln_w": _cast(_get(tensors, "embeddings.LayerNorm.weight"), dt),
+        "embed_ln_b": _cast(_get(tensors, "embeddings.LayerNorm.bias"), dt),
+        "layers": layers,
+    }
+
+
+def load_llama(cfg: ModelConfig, path: str) -> Params:
+    """Load a Llama/Mixtral-family checkpoint file or dir."""
+    return llama_params_from_hf(cfg, load_checkpoint_tensors(path))
+
+
+def load_encoder(cfg: EncoderConfig, path: str) -> Params:
+    """Load a BERT/e5-family checkpoint file or dir."""
+    return encoder_params_from_hf(cfg, load_checkpoint_tensors(path))

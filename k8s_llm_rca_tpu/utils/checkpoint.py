@@ -1,0 +1,87 @@
+"""Orbax checkpointing: model weights and train state.
+
+The reference has nothing to checkpoint (no weights in-repo; its only
+resume story is appending per-incident JSON, reference
+test_with_file.py:200-204 — preserved by sweeps/run_file.py, and thread
+reuse by retrieve_assistant/retrieve_thread ids, preserved by
+serve/api.py's state store).  This module adds the weight/optimizer side:
+
+- ``save_params`` / ``restore_params`` — one-shot pytree save of model
+  params (e.g. after converting an HF checkpoint via models/loader.py, so
+  later runs skip the transpose/cast pass);
+- ``TrainCheckpointer`` — step-numbered train-state checkpoints with
+  retention, built on ``orbax.checkpoint.CheckpointManager``; restore
+  targets an abstract pytree so arrays come back with the intended
+  shardings under a mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+PyTree = Any
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def save_params(path: str, params: PyTree) -> None:
+    """Save a param pytree to ``path`` (an empty/new directory)."""
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(_abs(path), params)
+
+
+def restore_params(path: str, like: Optional[PyTree] = None) -> PyTree:
+    """Restore a param pytree.  ``like`` (a matching pytree of arrays or
+    jax.ShapeDtypeStructs, possibly carrying shardings) restores arrays
+    placed per its specs; without it, arrays restore host-local."""
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is None:
+            return ckptr.restore(_abs(path))
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+        return ckptr.restore(_abs(path), abstract)
+
+
+class TrainCheckpointer:
+    """Step-numbered checkpoints of (params, opt_state) with retention.
+
+    Usage:
+        ckpt = TrainCheckpointer(dir, max_to_keep=3)
+        ckpt.save(step, {"params": params, "opt_state": opt_state})
+        state = ckpt.restore(like={"params": params0, "opt_state": opt0})
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            _abs(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def save(self, step: int, state: PyTree, wait: bool = True) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, like: Optional[PyTree] = None,
+                step: Optional[int] = None) -> PyTree:
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint steps saved yet")
+        if like is None:
+            return self._mgr.restore(step)
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.close()
