@@ -190,6 +190,10 @@ class EngineConfig:
     seed: int = 0
     # decode loop
     decode_chunk: int = 16             # device steps per host sync in scan mode
+    # host-side runtime: use the C++ components (page allocator, grammar
+    # mask engine) when a toolchain can build them; pure-Python fallback
+    # is behavior-identical
+    native: bool = True
 
 
 @dataclass(frozen=True)

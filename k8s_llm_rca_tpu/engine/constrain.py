@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from k8s_llm_rca_tpu.utils.logging import get_logger
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
 WS = " \t\n\r"
@@ -426,11 +427,22 @@ class JsonGrammar:
                     f"JSON grammar in state {self.auto.state}")
 
 
-def make_grammar(name: Optional[str],
-                 tokenizer: Tokenizer) -> Optional[JsonGrammar]:
-    """GenOptions.grammar -> FSM instance (None = unconstrained)."""
+def make_grammar(name: Optional[str], tokenizer: Tokenizer,
+                 prefer_native: bool = True):
+    """GenOptions.grammar -> FSM instance (None = unconstrained).
+
+    Prefers the C++ engine (native/, mask computation is O(V·len) per tick)
+    and falls back to the Python FSM when no toolchain is available; the
+    two are mask-for-mask identical (tests/test_native.py)."""
     if name is None:
         return None
     if name == "json":
+        if prefer_native:
+            try:
+                from k8s_llm_rca_tpu import native
+                if native.available():
+                    return native.NativeJsonGrammar(tokenizer)
+            except Exception as e:           # toolchain/ABI trouble: fall back
+                get_logger(__name__).debug("native grammar unavailable: %s", e)
         return JsonGrammar(tokenizer)
     raise ValueError(f"unknown grammar {name!r} (supported: 'json')")

@@ -118,6 +118,19 @@ class PageAllocator:
                 f"leaked pages: {sorted(universe - free - owned)}")
 
 
+def make_allocator(n_pages: int, prefer_native: bool = True):
+    """Page allocator factory: the C++ allocator (native/) when buildable,
+    else the Python one — identical interface and invariants."""
+    if prefer_native:
+        try:
+            from k8s_llm_rca_tpu import native
+            if native.available():
+                return native.NativePageAllocator(n_pages)
+        except Exception as e:
+            log.debug("native allocator unavailable: %s", e)
+    return PageAllocator(n_pages)
+
+
 # ---------------------------------------------------------------------------
 # paged model entry points
 # ---------------------------------------------------------------------------
@@ -247,7 +260,8 @@ class PagedInferenceEngine(EngineBase):
                 f"sequence ({self.pages_per_seq} pages + trash page)")
         self.k_pages, self.v_pages = init_paged_cache(
             model_cfg, engine_cfg.num_pages, self.page_size)
-        self.allocator = PageAllocator(engine_cfg.num_pages)
+        self.allocator = make_allocator(engine_cfg.num_pages,
+                                        engine_cfg.native)
 
         self.block_tables = np.full((b, self.pages_per_seq), TRASH_PAGE,
                                     np.int32)

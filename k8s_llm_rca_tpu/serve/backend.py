@@ -68,7 +68,8 @@ class EngineBackend:
     def start(self, prompt: str, opts: GenOptions) -> int:
         handle = next(self._handles)
         ids = self.tokenizer.encode(prompt + opts.forced_prefix, add_bos=True)
-        grammar = make_grammar(opts.grammar, self.tokenizer)
+        grammar = make_grammar(opts.grammar, self.tokenizer,
+                               prefer_native=self.engine.engine_cfg.native)
         # a grammar owns termination (forced EOS when the value closes);
         # stop strings must not also apply — e.g. "```" is a legal substring
         # INSIDE a JSON string, and a stop match there would truncate the
