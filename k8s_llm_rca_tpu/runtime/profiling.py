@@ -1,0 +1,171 @@
+"""Profiling & chip-level observability.
+
+The reference's only instrumentation is wall-clock bracketing with
+``time.time()`` (reference test_all.py:52,143-151 and
+test_with_file.py:173-175); utils/logging.py already upgrades that to
+structured counters/timers.  This module adds the chip-level layer SURVEY
+§5 calls for: ``jax.profiler`` trace capture (TensorBoard/XProf), device
+memory stats, and an analytic MFU/flops model for the decoder so benches
+and sweeps can report tokens/sec/chip against the hardware ceiling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+from k8s_llm_rca_tpu.config import ModelConfig
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+# bf16 peak TFLOP/s per chip for common parts; used for MFU when the local
+# device advertises one of these, else MFU is reported as None
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,     # v5e
+    "TPU v5": 459.0,          # v5p
+    "TPU v6 lite": 918.0,     # v6e / Trillium
+}
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a jax.profiler trace viewable in TensorBoard/XProf:
+
+        with profiling.trace("/tmp/rca-trace"):
+            engine.step()
+    """
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(log_dir, profiler_options=options)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region in the profiler timeline AND the METRICS timers."""
+    with jax.profiler.TraceAnnotation(name):
+        with METRICS.timer(name):
+            yield
+
+
+def device_memory_stats(device: Optional[Any] = None) -> Dict[str, float]:
+    """HBM usage for one device (bytes): bytes_in_use, peak_bytes_in_use,
+    bytes_limit where the backend reports them ({} otherwise)."""
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    keys = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size")
+    return {k: float(stats[k]) for k in keys if k in stats}
+
+
+# ---------------------------------------------------------------------------
+# analytic flops / MFU model (decoder)
+# ---------------------------------------------------------------------------
+
+
+def decoder_param_count(cfg: ModelConfig) -> int:
+    """Parameter count of the Llama/Mixtral stack (embeddings included)."""
+    h, q, kv, inter = (cfg.hidden_size, cfg.q_dim, cfg.kv_dim,
+                       cfg.intermediate_size)
+    per_layer = h * q + 2 * h * kv + q * h + 2 * h        # attn + norms
+    if cfg.n_experts > 0:
+        per_layer += h * cfg.n_experts                     # router
+        per_layer += cfg.n_experts * 3 * h * inter         # expert MLPs
+    else:
+        per_layer += 3 * h * inter
+    total = cfg.n_layers * per_layer
+    total += cfg.vocab_size * h                            # embedding
+    total += h                                             # final norm
+    if not cfg.tie_embeddings:
+        total += cfg.vocab_size * h                        # lm_head
+    return total
+
+
+def decode_flops_per_token(cfg: ModelConfig, context_len: int) -> float:
+    """FLOPs to decode ONE token at a given KV context length.
+
+    Matmul-dominated model: 2 FLOPs per MAC.  For MoE only the top-k
+    routed experts' MLPs count (hard dispatch); attention adds the
+    O(context) KV dot products.
+    """
+    h, q, kv, inter = (cfg.hidden_size, cfg.q_dim, cfg.kv_dim,
+                       cfg.intermediate_size)
+    per_layer = 2.0 * (h * q + 2 * h * kv + q * h)         # qkv + out proj
+    if cfg.n_experts > 0:
+        per_layer += 2.0 * h * cfg.n_experts               # router
+        per_layer += cfg.n_experts_per_tok * 2.0 * 3 * h * inter
+    else:
+        per_layer += 2.0 * 3 * h * inter
+    # attention scores + weighted values: q·K^T and P·V over the context
+    per_layer += 2.0 * 2 * cfg.n_heads * cfg.head_dim * context_len
+    total = cfg.n_layers * per_layer
+    total += 2.0 * h * cfg.vocab_size                      # logits matmul
+    return total
+
+
+def mfu(cfg: ModelConfig, tokens_per_sec: float, context_len: int,
+        device: Optional[Any] = None) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1] against the chip's bf16 peak;
+    None when the device kind has no table entry (e.g. CPU)."""
+    dev = device or jax.devices()[0]
+    kind = getattr(dev, "device_kind", "")
+    peak = None
+    for name, tf in _PEAK_TFLOPS.items():
+        if kind.startswith(name):
+            # exact-prefix pitfall: "TPU v5" also prefixes "TPU v5 lite";
+            # prefer the longest matching name
+            if peak is None or len(name) > peak[0]:
+                peak = (len(name), tf)
+    if peak is None:
+        return None
+    flops = decode_flops_per_token(cfg, context_len) * tokens_per_sec
+    return flops / (peak[1] * 1e12)
+
+
+@dataclass
+class StepTimer:
+    """Rolling decode-step timing for sweeps: tokens/sec and per-phase p50
+    without a profiler attached."""
+
+    started: float = 0.0
+    steps: int = 0
+    tokens: int = 0
+
+    def start(self) -> None:
+        self.started = time.perf_counter()
+        self.steps = 0
+        self.tokens = 0
+
+    def tick(self, n_tokens: int) -> None:
+        self.steps += 1
+        self.tokens += n_tokens
+
+    @property
+    def tokens_per_sec(self) -> float:
+        dt = time.perf_counter() - self.started
+        return self.tokens / dt if dt > 0 else 0.0
+
+    def report(self, cfg: Optional[ModelConfig] = None,
+               context_len: int = 512) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "steps": self.steps,
+            "tokens": self.tokens,
+            "tokens_per_sec": round(self.tokens_per_sec, 2),
+        }
+        if cfg is not None:
+            u = mfu(cfg, self.tokens_per_sec, context_len)
+            out["mfu"] = round(u, 4) if u is not None else None
+        out.update({f"hbm_{k}": v for k, v in device_memory_stats().items()})
+        return out

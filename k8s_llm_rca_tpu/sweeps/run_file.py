@@ -32,6 +32,26 @@ from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 log = get_logger(__name__)
 
 
+def chip_metrics(elapsed_s: float) -> dict:
+    """Chip-level observability for the sweep summary (SURVEY §5): decode
+    tokens/sec across the sweep, HBM stats, MFU when on a known TPU."""
+    from k8s_llm_rca_tpu.runtime import profiling
+
+    decode_tokens = METRICS.count("engine.decode_tokens")
+    decode_s = METRICS.total("engine.decode_step")
+    out = {
+        "decode_tokens": decode_tokens,
+        "prefill_tokens": METRICS.count("engine.prefill_tokens"),
+        "decode_tokens_per_sec": round(decode_tokens / decode_s, 2)
+        if decode_s > 0 else None,
+        "sweep_tokens_per_sec": round(decode_tokens / elapsed_s, 2)
+        if elapsed_s > 0 else None,
+    }
+    out.update({f"hbm_{k}": v
+                for k, v in profiling.device_memory_stats().items()})
+    return out
+
+
 def write_default_corpus(path: str, repeat: int = 1) -> None:
     """Materialize the built-in incident corpus as a driver CSV."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -131,6 +151,7 @@ def main(argv=None) -> dict:
         "wall_s": elapsed,
         "p50_incident_s": sorted(costs)[len(costs) // 2] if costs else 0.0,
         "metrics": METRICS.snapshot(),
+        "chip": chip_metrics(elapsed),
     }
     print(json.dumps({k: v for k, v in summary.items() if k != "metrics"}))
     meta.close()
