@@ -34,7 +34,15 @@ Params = Dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    """Slot-based contiguous KV cache: k/v are [L, B, S_max, n_kv, d]."""
+    """Slot-based contiguous KV cache: k/v are [L, B, S_max, n_kv*d].
+
+    The kv-head and head-dim axes are stored MERGED: TPU tiles the last two
+    axes of an array to (sublane, 128-lane) tiles, so a [..., n_kv, 64]
+    layout pads head_dim 64 -> 128 and silently doubles cache HBM and
+    attention read bandwidth.  [..., n_kv*64] keeps the lane axis a
+    multiple of 128; call sites reshape to per-head form next to the
+    attention einsum, where XLA fuses the (free, row-major) split.
+    """
 
     k: jnp.ndarray
     v: jnp.ndarray
@@ -111,7 +119,7 @@ def init_cache(cfg: ModelConfig, n_slots: int, max_seq_len: Optional[int] = None
         # (JAX out-of-bounds gather semantics) and corrupt rotations.
         raise ValueError(
             f"cache max_seq_len {s} exceeds model max_seq_len {cfg.max_seq_len}")
-    shape = (cfg.n_layers, n_slots, s, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, n_slots, s, cfg.kv_dim)
     dtype = jnp.dtype(cfg.dtype)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
@@ -241,20 +249,21 @@ def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
     """
     new_k, new_v, logits = prefill_kv(cfg, params, tokens, length)
 
-    # write [L, 1, S_pad, ...] into the slot row at sequence offset 0
+    # write [L, 1, S_pad, kv_dim] into the slot row at sequence offset 0
+    L, s_pad = new_k.shape[0], new_k.shape[1]
     k_cache = jax.lax.dynamic_update_slice(
-        cache.k, new_k[:, None], (0, slot, 0, 0, 0))
+        cache.k, new_k.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(
-        cache.v, new_v[:, None], (0, slot, 0, 0, 0))
+        cache.v, new_v.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
     return KVCache(k_cache, v_cache), logits
 
 
 def _write_token_kv(cache_layer: jnp.ndarray, kv_new: jnp.ndarray,
                     lengths: jnp.ndarray) -> jnp.ndarray:
-    """Scatter one token's k/v per slot: cache [B, S, n_kv, d], kv_new
-    [B, n_kv, d], written at per-slot index lengths[b]."""
+    """Scatter one token's k/v per slot: cache [B, S, kv_dim], kv_new
+    [B, kv_dim], written at per-slot index lengths[b]."""
     def write_one(c, kv, pos):
-        return jax.lax.dynamic_update_slice(c, kv[None], (pos, 0, 0))
+        return jax.lax.dynamic_update_slice(c, kv[None], (pos, 0))
 
     return jax.vmap(write_one)(cache_layer, kv_new, lengths)
 
@@ -273,15 +282,21 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
     positions = lengths[:, None]                       # [B, 1]
     x = params["embedding"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
 
+    s_max = cache.max_seq_len
     new_ks, new_vs = [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(cfg, layer, h, angles, positions)   # q [B,1,h,d]
-        k_cache = _write_token_kv(cache.k[li], k[:, 0], lengths)
-        v_cache = _write_token_kv(cache.v[li], v[:, 0], lengths)
+        k_cache = _write_token_kv(cache.k[li], k[:, 0].reshape(b, cfg.kv_dim),
+                                  lengths)
+        v_cache = _write_token_kv(cache.v[li], v[:, 0].reshape(b, cfg.kv_dim),
+                                  lengths)
         new_ks.append(k_cache)
         new_vs.append(v_cache)
-        attn = decode_attention(q, k_cache, v_cache, lengths + 1)
+        attn = decode_attention(
+            q, k_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            v_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            lengths + 1)
         x = x + attn.reshape(b, 1, cfg.q_dim) @ layer["wo"]
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, layer, hm)

@@ -94,8 +94,13 @@ def encoder_param_specs(cfg) -> Dict[str, Any]:
 
 
 def kv_cache_specs() -> Any:
-    """KV cache [L, B, S, n_kv, d]: batch on data, kv-heads on model."""
-    return P(None, "data", None, "model", None)
+    """KV cache [L, B, S, n_kv*d] (merged kv axis, models/llama.KVCache):
+    batch on data, the merged kv-head*head_dim axis on model — splitting the
+    merged axis over "model" is identical to sharding the kv-head axis it
+    row-major-contains when the "model" axis size divides n_kv; larger
+    meshes split inside heads (still correct shapes, but collectives land
+    mid-head — size the mesh like wk/wv columns)."""
+    return P(None, "data", None, "model")
 
 
 def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:

@@ -111,3 +111,35 @@ def test_expert_parallel_moe_drops_under_pressure(cpu_devices):
                                 capacity_factor=0.01)
     dense = llama._moe_mlp(cfg, layer, x)
     assert float(jnp.abs(tight).sum()) < float(jnp.abs(dense).sum())
+
+
+def test_kv_cache_spec_sharded_decode_matches_unsharded(cpu_devices):
+    """kv_cache_specs must match the merged cache rank ([L, B, S, n_kv*d])
+    and a decode step over the sharded cache must equal the unsharded one."""
+    from k8s_llm_rca_tpu.config import TINY
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        kv_cache_specs, llama_param_specs, shard_pytree,
+    )
+
+    cfg = TINY
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cache = llama.init_cache(cfg, n_slots=4, max_seq_len=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                cfg.vocab_size)
+    cache, _ = jax.jit(llama.prefill, static_argnums=0)(
+        cfg, params, cache, prompt, jnp.int32(16), jnp.int32(0))
+    cur = jnp.full((4,), 5, jnp.int32)
+    lengths = jnp.asarray([16, 0, 0, 0], jnp.int32)
+    ref_cache, ref_logits = jax.jit(llama.decode_step, static_argnums=0)(
+        cfg, params, cache, cur, lengths)
+
+    sharded_params = shard_pytree(params, llama_param_specs(cfg), mesh)
+    spec = kv_cache_specs()
+    sharded_cache = shard_pytree(cache, llama.KVCache(spec, spec), mesh)
+    out_cache, logits = jax.jit(llama.decode_step, static_argnums=0)(
+        cfg, sharded_params, sharded_cache, cur, lengths)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(out_cache.k),
+                               np.asarray(ref_cache.k), rtol=1e-5, atol=1e-5)
