@@ -2,8 +2,11 @@
 
 vLLM-style paging re-designed for XLA's static shapes:
 
-- the KV pool is one [L, n_kv, n_pages, page_size, d] array per k/v —
-  every shape static, so prefill/decode compile once;
+- the KV pool is one [L, n_pages, page_size, n_kv*d] array per k/v —
+  every shape static, so prefill/decode compile once; the kv-head and
+  head-dim axes are merged on the lane axis so TPU tiling doesn't pad
+  head_dim 64 -> 128 (see ops/paged_attention.py and models/llama.KVCache
+  for the same layout rule);
 - **page 0 is the reserved trash page**: block-table entries past a
   sequence's live pages point at it, so scatter/gather indices are
   always in-bounds (JAX clamps out-of-bounds anyway, but clamping would
@@ -137,7 +140,7 @@ def make_allocator(n_pages: int, prefer_native: bool = True):
 
 
 def init_paged_cache(cfg: ModelConfig, n_pages: int, page_size: int):
-    shape = (cfg.n_layers, cfg.n_kv_heads, n_pages, page_size, cfg.head_dim)
+    shape = (cfg.n_layers, n_pages, page_size, cfg.kv_dim)
     dtype = jnp.dtype(cfg.dtype)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
@@ -152,19 +155,19 @@ def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
     must be TRASH_PAGE).  Returns (k_pages', v_pages', logits [1, V]).
     """
     _, s_pad = tokens.shape
-    page_size = k_pages.shape[3]
+    page_size = k_pages.shape[2]
     assert s_pad % page_size == 0, (s_pad, page_size)
     new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length)
 
     n_seq_pages = s_pad // page_size
-    # [L, S_pad, n_kv, d] -> [L, n_kv, n_seq_pages, page_size, d]
+
+    # [L, S_pad, n_kv, d] -> [L, n_seq_pages, page_size, n_kv*d]
     def to_pages(a):
         L = a.shape[0]
-        a = a.reshape(L, n_seq_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
-        return a.transpose(0, 3, 1, 2, 4)
+        return a.reshape(L, n_seq_pages, page_size, cfg.kv_dim)
 
-    k_pages = k_pages.at[:, :, page_map].set(to_pages(new_k))
-    v_pages = v_pages.at[:, :, page_map].set(to_pages(new_v))
+    k_pages = k_pages.at[:, page_map].set(to_pages(new_k))
+    v_pages = v_pages.at[:, page_map].set(to_pages(new_v))
     return k_pages, v_pages, logits
 
 
@@ -180,7 +183,7 @@ def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
     offset lengths[b] % page.  Returns (k_pages', v_pages', logits).
     """
     b = tokens.shape[0]
-    page_size = k_pages.shape[3]
+    page_size = k_pages.shape[2]
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = lengths[:, None]
     x = params["embedding"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
@@ -197,11 +200,11 @@ def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = llama._qkv(cfg, layer, h, angles, positions)  # [B,1,·,d]
-        # scatter this token's k/v: [B, n_kv, d] -> pool[li, :, page, off]
-        kp = k_pages[li].at[:, page_ids, offsets].set(
-            k[:, 0].transpose(1, 0, 2))
-        vp = v_pages[li].at[:, page_ids, offsets].set(
-            v[:, 0].transpose(1, 0, 2))
+        # scatter this token's k/v: [B, n_kv*d] -> pool[li, page, off]
+        kp = k_pages[li].at[page_ids, offsets].set(
+            k[:, 0].reshape(b, cfg.kv_dim))
+        vp = v_pages[li].at[page_ids, offsets].set(
+            v[:, 0].reshape(b, cfg.kv_dim))
         k_pages = k_pages.at[li].set(kp)
         v_pages = v_pages.at[li].set(vp)
         attn = attn_fn(q[:, 0], kp, vp, lengths + 1, block_tables)

@@ -8,19 +8,32 @@ named by the block table — no host-side gather, no dense [B, S_max]
 cache).
 
 Layouts:
-- ``k_pages``/``v_pages``: [n_kv_heads, n_pages, page_size, head_dim] —
-  head-major so one (head, page) block is contiguous in HBM.
+- ``k_pages``/``v_pages``: [n_pages, page_size, n_kv*d] — the kv-head and
+  head-dim axes are stored MERGED on the lane axis.  TPU tiles the last
+  two axes to (sublane, 128-lane) tiles; a per-head [..., page, d=64]
+  layout would pad d 64 -> 128 and double both pool HBM and page DMA
+  traffic.  With the merged axis the lane dim is n_kv*d (a multiple of
+  128 for every real config) and pages are stored/streamed unpadded.
 - ``block_tables``: [B, pages_per_seq] int32 page ids; entries past a
   sequence's length MUST still be valid ids (the allocator uses 0) —
   they are fetched but masked out of the softmax.
 - ``lengths``: [B] valid kv tokens per sequence (including the current
   decode position).
 
-Grid is (batch, kv_head, page); the page axis is innermost and carries
-running max / denominator / accumulator scratch across the sweep
-(online softmax, same scheme as ops/flash_attention.py).  All n_rep
-GQA query heads for one kv head are processed together as the rows of
-an [n_rep, d] tile.
+Because a page block now carries ALL kv heads side by side on lanes, the
+kernel processes every query head in one grid step using a
+block-diagonal-q trick: queries are pre-expanded to [n_heads, n_kv*d]
+with each row zero everywhere except its own kv-head's d-slice, so the
+single [n_heads, n_kv*d] x [page, n_kv*d]^T matmul contracts over the
+merged axis and the zeros kill every cross-head term.  The p @ v matmul
+produces [n_heads, n_kv*d] whose valid output lives on the row's own
+d-slice; the caller extracts that block diagonal with one cheap gather.
+This trades a constant-factor of extra MXU work (the zero blocks) for
+halved DMA on an op that is bandwidth-bound — the right trade on TPU.
+
+Grid is (batch, page); the page axis is innermost and carries running
+max / denominator / accumulator scratch across the sweep (online
+softmax, same scheme as ops/flash_attention.py).
 
 The reference has no KV cache at all (server-side, reference
 common/openai_generic_assistant.py:45-51); SURVEY §2.2 names the paged
@@ -43,20 +56,21 @@ _LANES = 128
 def _paged_kernel(
     lengths_ref,        # SMEM [B]
     tables_ref,         # SMEM [B, pages_per_seq]  (index-map only)
-    q_ref,              # VMEM [1, 1, n_rep, d]
-    k_ref,              # VMEM [1, 1, page_size, d]
-    v_ref,              # VMEM [1, 1, page_size, d]
-    o_ref,              # VMEM [1, 1, n_rep, d]
-    acc_ref,            # VMEM scratch [n_rep, d] f32
-    m_ref,              # VMEM scratch [n_rep, _LANES] f32
-    l_ref,              # VMEM scratch [n_rep, _LANES] f32
+    q_ref,              # VMEM [1, n_heads, KV]  (block-diagonal expanded)
+    k_ref,              # VMEM [1, page_size, KV]
+    v_ref,              # VMEM [1, page_size, KV]
+    o_ref,              # VMEM [1, n_heads, KV]
+    acc_ref,            # VMEM scratch [n_heads, KV] f32
+    m_ref,              # VMEM scratch [n_heads, _LANES] f32
+    l_ref,              # VMEM scratch [n_heads, _LANES] f32
     *,
     page_size: int,
+    head_dim: int,
 ):
     del tables_ref
     bi = pl.program_id(0)
-    j = pl.program_id(2)
-    n_pages = pl.num_programs(2)
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
@@ -68,19 +82,21 @@ def _paged_kernel(
 
     @pl.when(j * page_size < length)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)            # [n_rep, d]
-        k = k_ref[0, 0].astype(jnp.float32)            # [page, d]
-        v = v_ref[0, 0].astype(jnp.float32)            # [page, d]
-        n_rep = q.shape[0]
+        q = q_ref[0].astype(jnp.float32)               # [n_heads, KV]
+        k = k_ref[0].astype(jnp.float32)               # [page, KV]
+        v = v_ref[0].astype(jnp.float32)               # [page, KV]
+        n_heads = q.shape[0]
 
-        scale = jax.lax.rsqrt(jnp.float32(q.shape[-1]))
+        # rows of q are zero outside their own kv-head's d-slice, so
+        # contracting over the merged axis equals the per-head q.k dot
+        scale = jax.lax.rsqrt(jnp.float32(head_dim))
         s = jax.lax.dot_general(
             q * scale, k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                              # [n_rep, page]
+        )                                              # [n_heads, page]
 
-        k_pos = (jax.lax.broadcasted_iota(jnp.int32, (n_rep, page_size), 1)
+        k_pos = (jax.lax.broadcasted_iota(jnp.int32, (n_heads, page_size), 1)
                  + j * page_size)
         s = jnp.where(k_pos < length, s, NEG_INF)
 
@@ -96,21 +112,42 @@ def _paged_kernel(
             p, v,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        )                                              # [n_heads, KV]
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(j == n_pages - 1)
     def _finalize():
         l = l_ref[:, 0:1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def _expand_block_diag(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:
+    """[B, n_heads, d] -> [B, n_heads, n_kv*d] with row i nonzero only on
+    kv-head (i // n_rep)'s d-slice."""
+    b, n_heads, d = q.shape
+    n_rep = n_heads // n_kv
+    head_kv = jnp.arange(n_heads) // n_rep                     # [n_heads]
+    onehot = jax.nn.one_hot(head_kv, n_kv, dtype=q.dtype)      # [n_heads, n_kv]
+    return (q[:, :, None, :] * onehot[None, :, :, None]).reshape(
+        b, n_heads, n_kv * d)
+
+
+def _extract_block_diag(out: jnp.ndarray, n_kv: int, d: int) -> jnp.ndarray:
+    """[B, n_heads, n_kv*d] -> [B, n_heads, d], keeping each row's own
+    kv-head d-slice."""
+    b, n_heads, _ = out.shape
+    n_rep = n_heads // n_kv
+    head_kv = jnp.arange(n_heads) // n_rep
+    out = out.reshape(b, n_heads, n_kv, d)
+    return out[:, jnp.arange(n_heads), head_kv]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention(
     q: jnp.ndarray,             # [B, n_heads, d]
-    k_pages: jnp.ndarray,       # [n_kv, n_pages, page_size, d]
-    v_pages: jnp.ndarray,       # [n_kv, n_pages, page_size, d]
+    k_pages: jnp.ndarray,       # [n_pages, page_size, n_kv*d]
+    v_pages: jnp.ndarray,       # [n_pages, page_size, n_kv*d]
     lengths: jnp.ndarray,       # [B] int32
     block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
     *,
@@ -121,48 +158,47 @@ def paged_attention(
         interpret = jax.default_backend() != "tpu"
 
     b, n_heads, d = q.shape
-    n_kv, _, page_size, _ = k_pages.shape
-    n_rep = n_heads // n_kv
+    _, page_size, kv_dim = k_pages.shape
+    assert kv_dim % d == 0, (kv_dim, d)
+    n_kv = kv_dim // d
+    assert n_heads % n_kv == 0, (n_heads, n_kv)
     pages_per_seq = block_tables.shape[1]
 
-    q4 = q.reshape(b, n_kv, n_rep, d)
-    grid = (b, n_kv, pages_per_seq)
+    q_exp = _expand_block_diag(q, n_kv)
+    grid = (b, pages_per_seq)
 
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, page_size=page_size),
+        functools.partial(_paged_kernel, page_size=page_size, head_dim=d),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, n_rep, d),
-                             lambda bi, h, j, lens, tabs: (bi, h, 0, 0)),
-                pl.BlockSpec((1, 1, page_size, d),
-                             lambda bi, h, j, lens, tabs:
-                             (h, tabs[bi, j], 0, 0)),
-                pl.BlockSpec((1, 1, page_size, d),
-                             lambda bi, h, j, lens, tabs:
-                             (h, tabs[bi, j], 0, 0)),
+                pl.BlockSpec((1, n_heads, kv_dim),
+                             lambda bi, j, lens, tabs: (bi, 0, 0)),
+                pl.BlockSpec((1, page_size, kv_dim),
+                             lambda bi, j, lens, tabs: (tabs[bi, j], 0, 0)),
+                pl.BlockSpec((1, page_size, kv_dim),
+                             lambda bi, j, lens, tabs: (tabs[bi, j], 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, n_rep, d),
-                                   lambda bi, h, j, lens, tabs:
-                                   (bi, h, 0, 0)),
+            out_specs=pl.BlockSpec((1, n_heads, kv_dim),
+                                   lambda bi, j, lens, tabs: (bi, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((n_rep, d), jnp.float32),
-                pltpu.VMEM((n_rep, _LANES), jnp.float32),
-                pltpu.VMEM((n_rep, _LANES), jnp.float32),
+                pltpu.VMEM((n_heads, kv_dim), jnp.float32),
+                pltpu.VMEM((n_heads, _LANES), jnp.float32),
+                pltpu.VMEM((n_heads, _LANES), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, n_kv, n_rep, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, n_heads, kv_dim), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(
         lengths.astype(jnp.int32),
         block_tables.astype(jnp.int32),
-        q4, k_pages, v_pages,
+        q_exp, k_pages, v_pages,
     )
-    return out.reshape(b, n_heads, d)
+    return _extract_block_diag(out, n_kv, d)
 
 
 def paged_attention_xla(
@@ -178,14 +214,17 @@ def paged_attention_xla(
     platforms without Mosaic.
     """
     b, n_heads, d = q.shape
-    n_kv, _, page_size, _ = k_pages.shape
+    _, page_size, kv_dim = k_pages.shape
+    assert kv_dim % d == 0, (kv_dim, d)
+    n_kv = kv_dim // d
+    assert n_heads % n_kv == 0, (n_heads, n_kv)
     n_rep = n_heads // n_kv
 
-    # [B, n_kv, pages_per_seq, page, d] -> [B, S_max, n_kv, d]
-    k = jnp.take(k_pages, block_tables, axis=1)        # [n_kv, B, pp, page, d]
-    v = jnp.take(v_pages, block_tables, axis=1)
-    k = k.transpose(1, 2, 3, 0, 4).reshape(b, -1, n_kv, d)
-    v = v.transpose(1, 2, 3, 0, 4).reshape(b, -1, n_kv, d)
+    # [B, pp, page, KV] -> [B, S_max, n_kv, d]
+    k = jnp.take(k_pages, block_tables, axis=0)
+    v = jnp.take(v_pages, block_tables, axis=0)
+    k = k.reshape(b, -1, n_kv, d)
+    v = v.reshape(b, -1, n_kv, d)
 
     k = jnp.repeat(k, n_rep, axis=2).astype(jnp.float32)
     v = jnp.repeat(v, n_rep, axis=2).astype(jnp.float32)

@@ -68,8 +68,8 @@ class TestFlashAttention:
 class TestPagedAttention:
     def _mk_pool(self, key, n_kv, n_pages, page, d):
         kk, kv = jax.random.split(key)
-        kp = jax.random.normal(kk, (n_kv, n_pages, page, d))
-        vp = jax.random.normal(kv, (n_kv, n_pages, page, d))
+        kp = jax.random.normal(kk, (n_pages, page, n_kv * d))
+        vp = jax.random.normal(kv, (n_pages, page, n_kv * d))
         return kp, vp
 
     @pytest.mark.parametrize("n_heads,n_kv", [(4, 4), (8, 2)])
