@@ -96,6 +96,21 @@ int32_t pagealloc_free(void* h, const int32_t* pages, int32_t n,
   return OK;
 }
 
+int32_t pagealloc_transfer(void* h, const int32_t* pages, int32_t n,
+                           int64_t from_owner, int64_t to_owner) {
+  auto* a = static_cast<PageAlloc*>(h);
+  // validate all pages first so a failed transfer changes nothing
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t p = pages[i];
+    if (p == 0) return ERR_TRASH_PAGE;
+    auto it = a->owner.find(p);
+    if (it == a->owner.end()) return ERR_DOUBLE_FREE;
+    if (it->second != from_owner) return ERR_FOREIGN_PAGE;
+  }
+  for (int32_t i = 0; i < n; ++i) a->owner[pages[i]] = to_owner;
+  return OK;
+}
+
 int32_t pagealloc_pages_of(void* h, int64_t owner_tag, int32_t* out,
                            int32_t cap) {
   auto* a = static_cast<PageAlloc*>(h);

@@ -183,6 +183,10 @@ class EngineConfig:
     paged: bool = False
     page_size: int = 16
     num_pages: int = 1024
+    # share page-aligned prompt-prefix KV between sequences (paged engine
+    # only; engine/prefix.py) — the RCA agent threads grow monotonically,
+    # so consecutive runs re-submit almost identical prompts
+    prefix_cache: bool = True
     # sampling defaults
     temperature: float = 0.0           # 0 == greedy
     top_k: int = 0

@@ -62,6 +62,7 @@ class _Active:
     max_new_tokens: int = 256
     stop_strings: Tuple[str, ...] = ()
     grammar: Optional[object] = None    # engine/constrain.py FSM (stateful)
+    n_shared: int = 0   # leading block-table pages owned by the prefix cache
 
 
 @dataclass

@@ -45,6 +45,7 @@ from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.paged_attention import (
     paged_attention, paged_attention_xla,
 )
+from k8s_llm_rca_tpu.engine.prefix import PrefixCache
 from k8s_llm_rca_tpu.ops.rope import rope_frequencies
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
@@ -107,6 +108,22 @@ class PageAllocator:
             del self._owner[p]
             self._free.append(p)
 
+    def transfer(self, pages: Sequence[int], from_owner: int,
+                 to_owner: int) -> None:
+        """Re-tag ownership (e.g. sequence -> prefix cache).  Validates every
+        page first so a failed transfer changes nothing."""
+        for p in pages:
+            if p == TRASH_PAGE:
+                raise AllocatorError("attempt to transfer the trash page")
+            got = self._owner.get(p)
+            if got is None:
+                raise AllocatorError(f"transfer of free page {p}")
+            if got != from_owner:
+                raise AllocatorError(
+                    f"page {p} owned by {got}, transferred by {from_owner}")
+        for p in pages:
+            self._owner[p] = to_owner
+
     def check(self) -> None:
         """Global invariant: free ∪ owned == all pages, disjoint."""
         free: Set[int] = set(self._free)
@@ -168,6 +185,87 @@ def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
 
     k_pages = k_pages.at[:, page_map].set(to_pages(new_k))
     v_pages = v_pages.at[:, page_map].set(to_pages(new_v))
+    return k_pages, v_pages, logits
+
+
+def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
+    """Masked fp32 softmax attention for chunked prefill.
+
+    q [1, C, n_heads, d]; k_all/v_all [1, S, n_kv, d]; mask [C, S] — the
+    caller builds the causal+validity mask in ABSOLUTE positions because
+    the gathered prefix buffer is padded to a static page count, so buffer
+    index != absolute position (ops/attention.causal_attention assumes
+    they're equal and can't be reused here).
+    """
+    from k8s_llm_rca_tpu.ops.attention import NEG_INF, repeat_kv
+
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    k = repeat_kv(k_all, n_rep).astype(jnp.float32)
+    v = repeat_kv(v_all, n_rep).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.head_dim))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k) * scale
+    logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out.astype(q.dtype)
+
+
+def paged_prefill_chunk(cfg: ModelConfig, params, k_pages, v_pages,
+                        tokens: jnp.ndarray, chunk_len: jnp.ndarray,
+                        prefix_len: jnp.ndarray, prefix_table: jnp.ndarray,
+                        page_map: jnp.ndarray):
+    """Prefill the non-cached SUFFIX of a prompt whose first ``prefix_len``
+    tokens' KV already sit in pool pages (prefix-cache hit).
+
+    tokens [1, C_pad] right-padded chunk (``chunk_len`` valid), absolute
+    positions ``prefix_len + i``; prefix_table [pages_per_seq] page ids
+    whose first ``prefix_len // page_size`` entries hold the cached prefix
+    (later entries arbitrary — masked); page_map [C_pad // page_size] new
+    pages receiving the chunk's KV.  Returns (k_pages', v_pages',
+    logits [1, V] at the last valid chunk token).
+    """
+    _, c_pad = tokens.shape
+    page_size = k_pages.shape[2]
+    assert c_pad % page_size == 0, (c_pad, page_size)
+    n_chunk_pages = c_pad // page_size
+    s_prefix = prefix_table.shape[0] * page_size
+
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = prefix_len + jnp.arange(c_pad)[None, :]          # [1, C]
+    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
+
+    # causal + validity mask in absolute positions (static shapes)
+    q_pos = prefix_len + jnp.arange(c_pad)                       # [C]
+    k_abs = jnp.concatenate([jnp.arange(s_prefix), q_pos])       # [S]
+    k_valid = jnp.concatenate([
+        jnp.arange(s_prefix) < prefix_len,
+        jnp.arange(c_pad) < chunk_len,
+    ])
+    mask = (q_pos[:, None] >= k_abs[None, :]) & k_valid[None, :]  # [C, S]
+
+    ks, vs = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._qkv(cfg, layer, h, angles, positions)
+        # gather the cached prefix: [pp, page, kv_dim] -> [1, S_pre, n_kv, d]
+        kp = k_pages[li][prefix_table].reshape(
+            1, s_prefix, cfg.n_kv_heads, cfg.head_dim)
+        vp = v_pages[li][prefix_table].reshape(
+            1, s_prefix, cfg.n_kv_heads, cfg.head_dim)
+        attn = _chunk_attention(cfg, q,
+                                jnp.concatenate([kp, k], axis=1),
+                                jnp.concatenate([vp, v], axis=1), mask)
+        x = x + attn.reshape(1, c_pad, cfg.q_dim) @ layer["wo"]
+        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(cfg, layer, hm)
+        ks.append(k[0].reshape(n_chunk_pages, page_size, cfg.kv_dim))
+        vs.append(v[0].reshape(n_chunk_pages, page_size, cfg.kv_dim))
+
+    k_pages = k_pages.at[:, page_map].set(jnp.stack(ks))
+    v_pages = v_pages.at[:, page_map].set(jnp.stack(vs))
+
+    last = jax.lax.dynamic_slice_in_dim(x, chunk_len - 1, 1, axis=1)
+    logits = llama._logits(cfg, params, last)[:, 0]              # [1, V]
     return k_pages, v_pages, logits
 
 
@@ -265,6 +363,8 @@ class PagedInferenceEngine(EngineBase):
             model_cfg, engine_cfg.num_pages, self.page_size)
         self.allocator = make_allocator(engine_cfg.num_pages,
                                         engine_cfg.native)
+        self.prefix_cache = (PrefixCache(self.allocator, self.page_size)
+                             if engine_cfg.prefix_cache else None)
 
         self.block_tables = np.full((b, self.pages_per_seq), TRASH_PAGE,
                                     np.int32)
@@ -286,6 +386,8 @@ class PagedInferenceEngine(EngineBase):
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
         self._prefill = jax.jit(paged_prefill, static_argnums=0,
                                 donate_argnums=donate)
+        self._prefill_chunk = jax.jit(paged_prefill_chunk, static_argnums=0,
+                                      donate_argnums=donate)
         self._decode = jax.jit(
             paged_decode_step, static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
@@ -386,30 +488,81 @@ class PagedInferenceEngine(EngineBase):
                 return -(-b // self.page_size) * self.page_size
         return self.pages_per_seq * self.page_size
 
+    def _alloc_with_evict(self, n: int, owner: int) -> List[int]:
+        """Allocate, evicting refcount-0 prefix-cache pages on pressure."""
+        try:
+            return self.allocator.alloc(n, owner=owner)
+        except OutOfPages:
+            if self.prefix_cache is None:
+                raise
+            need = n - self.allocator.n_free
+            if self.prefix_cache.evict(need) < need:
+                raise
+            return self.allocator.alloc(n, owner=owner)
+
     def _admit(self, req: _Pending) -> Optional[SequenceResult]:
         n = len(req.prompt_ids)
-        bucket = self._bucket(n)
+        cached_pages: List[int] = []
+        n_cached = 0
+        if self.prefix_cache is not None:
+            cached_pages, n_cached = self.prefix_cache.match(req.prompt_ids)
+        n_cp = len(cached_pages)
+        rest = req.prompt_ids[n_cached:]
+        # cap the bucket at the table space left after the cached prefix
+        # (always >= len(rest): n_cached + len(rest) <= pages_per_seq * page)
+        bucket = min(self._bucket(len(rest)),
+                     (self.pages_per_seq - n_cp) * self.page_size)
+        assert len(rest) <= bucket, (len(rest), bucket)
         n_pages = bucket // self.page_size
-        pages = self.allocator.alloc(n_pages, owner=req.seq_id)  # OutOfPages?
+        try:
+            pages = self._alloc_with_evict(n_pages, owner=req.seq_id)
+        except OutOfPages:
+            if cached_pages:
+                self.prefix_cache.release(cached_pages)
+            raise
         slot = self._free_slots.pop(0)
 
         table = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
-        table[:n_pages] = pages
+        table[:n_cp] = cached_pages
+        table[n_cp:n_cp + n_pages] = pages
         self.block_tables[slot] = table
 
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = req.prompt_ids
+        padded[0, :len(rest)] = rest
         with METRICS.timer("engine.prefill"):
-            self.k_pages, self.v_pages, logits = self._prefill(
-                self.model_cfg, self.params, self.k_pages, self.v_pages,
-                jnp.asarray(padded), jnp.int32(n), jnp.asarray(table[:n_pages]))
+            if n_cached:
+                # pad the prefix table to the next power of two of page
+                # counts: the chunk-prefill gathers/attends over the whole
+                # passed table, so its length should track the actual
+                # prefix (bounded compile count, ~log2(pages_per_seq))
+                pb = 1
+                while pb < n_cp:
+                    pb *= 2
+                prefix_table = np.full((pb,), TRASH_PAGE, np.int32)
+                prefix_table[:n_cp] = table[:n_cp]
+                self.k_pages, self.v_pages, logits = self._prefill_chunk(
+                    self.model_cfg, self.params, self.k_pages, self.v_pages,
+                    jnp.asarray(padded), jnp.int32(len(rest)),
+                    jnp.int32(n_cached), jnp.asarray(prefix_table),
+                    jnp.asarray(table[n_cp:n_cp + n_pages]))
+                METRICS.inc("engine.prefix_hit_tokens", n_cached)
+            else:
+                self.k_pages, self.v_pages, logits = self._prefill(
+                    self.model_cfg, self.params, self.k_pages, self.v_pages,
+                    jnp.asarray(padded), jnp.int32(n),
+                    jnp.asarray(table[:n_pages]))
             self._key, sub = jax.random.split(self._key)
             first = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.prefill_tokens", n)
+        METRICS.inc("engine.prefill_tokens", len(rest))
 
+        n_shared = n_cp
+        if self.prefix_cache is not None:
+            n_shared = self.prefix_cache.insert(req.prompt_ids, table,
+                                                req.seq_id, n_cp)
         st = _Active(seq_id=req.seq_id, slot=slot, prompt_tokens=n,
                      max_new_tokens=req.max_new_tokens,
-                     stop_strings=req.stop_strings, grammar=req.grammar)
+                     stop_strings=req.stop_strings, grammar=req.grammar,
+                     n_shared=n_shared)
         token = int(first[0])
         if st.grammar is not None:
             remaining = min(st.max_new_tokens,
@@ -433,7 +586,7 @@ class PagedInferenceEngine(EngineBase):
             return                              # at cap; finish_reason handles
         if self.block_tables[slot, idx] != TRASH_PAGE:
             return                              # page already present
-        (page,) = self.allocator.alloc(1, owner=st.seq_id)
+        (page,) = self._alloc_with_evict(1, owner=st.seq_id)
         self.block_tables[slot, idx] = page
 
     def _preempt_youngest(self, exclude: Optional[int] = None) -> bool:
@@ -445,11 +598,20 @@ class PagedInferenceEngine(EngineBase):
         self._preempt_slot(slot)
         return True
 
+    def _release_slot_pages(self, slot: int, st: _Active) -> None:
+        """Return a slot's pages: shared prefix back to the prefix cache
+        (refcount drop), private pages to the allocator."""
+        table = self.block_tables[slot]
+        shared = [int(p) for p in table[:st.n_shared]]
+        private = [int(p) for p in table[st.n_shared:] if p != TRASH_PAGE]
+        if shared:
+            self.prefix_cache.release(shared)
+        if private:
+            self.allocator.free(private, owner=st.seq_id)
+
     def _preempt_slot(self, slot: int) -> None:
         st = self._active.pop(slot)
-        pages = [int(p) for p in self.block_tables[slot]
-                 if p != TRASH_PAGE]
-        self.allocator.free(pages, owner=st.seq_id)
+        self._release_slot_pages(slot, st)
         self.block_tables[slot] = TRASH_PAGE
         self._free_slots.append(slot)
         # requeue at the FRONT with context so far; re-prefill resumes it.
@@ -471,9 +633,7 @@ class PagedInferenceEngine(EngineBase):
 
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)
-        pages = [int(p) for p in self.block_tables[slot]
-                 if p != TRASH_PAGE]
-        self.allocator.free(pages, owner=st.seq_id)
+        self._release_slot_pages(slot, st)
         self.allocator.check()
         self.block_tables[slot] = TRASH_PAGE
         self._free_slots.append(slot)

@@ -1,0 +1,187 @@
+"""Page-granular prefix cache: KV reuse across requests that share a prompt
+prefix.
+
+Why this exists: the reference's agent threads grow monotonically — every
+retry and every per-entity audit appends to one OpenAI thread whose full
+history is re-submitted on each run (reference check_state/
+analyze_root_cause.py:184,243, test_all.py:70-83) — so consecutive runs in
+an RCA incident share almost their entire prompt.  Server-side that cost is
+invisible; in-tree it means re-prefilling thousands of identical tokens per
+run.  This cache shares the paged KV of page-aligned prompt prefixes
+between sequences (vLLM "automatic prefix caching" re-designed for this
+engine's page pool).
+
+Design:
+- The key of page ``i`` of a prompt is a digest of tokens ``[0, (i+1)*P)``
+  (P = page_size): KV at a position depends on every earlier token, so a
+  page is reusable only under an exact full-prefix match.
+- Shared pages are owned by the allocator owner tag ``CACHE_OWNER``;
+  per-page refcounts track active users.  Pages at refcount 0 stay
+  resident (and chained) in an LRU pool; ``evict`` frees them back to the
+  allocator under memory pressure.  A page with refcount > 0 is never
+  evicted, so block tables of running sequences stay valid.
+- Sharing is read-only by construction: a shared page covers positions
+  < n_cached <= prompt_len, and decode only writes at positions >=
+  prompt_len; sequences never write into a page they share.
+- ``insert`` keeps the shared run contiguous: it stops at the first full
+  page whose key is already chained to a *different* page (a concurrent
+  duplicate prefill) — that page stays private to its sequence.
+
+The reference has no KV reuse of any kind (every run re-bills the full
+prompt, reference common/openai_generic_assistant.py:117-135); this is a
+TPU-native engine feature the build adds on top of the paged pool.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+# allocator owner tag for shared pages (sequence ids are >= 0)
+CACHE_OWNER = -2
+
+
+def _page_keys(prompt_ids: Sequence[int], n_pages: int,
+               page_size: int) -> List[bytes]:
+    """Chained digests: key_i = H(key_{i-1} || tokens of page i).
+
+    Chaining keeps each page's key dependent on the FULL prefix (KV at a
+    position depends on every earlier token) while costing O(n) total,
+    not O(n^2) of re-hashing the whole prefix per page."""
+    keys: List[bytes] = []
+    prev = b""
+    arr = np.asarray(prompt_ids[:n_pages * page_size], np.int32)
+    for i in range(n_pages):
+        h = hashlib.sha1(prev)
+        h.update(arr[i * page_size:(i + 1) * page_size].tobytes())
+        prev = h.digest()
+        keys.append(prev)
+    return keys
+
+
+class PrefixCache:
+    """Host-side index of shared prompt-prefix pages.
+
+    The allocator stays the single owner-of-record of page ids; this class
+    only re-tags ownership (seq <-> CACHE_OWNER via ``transfer``) and
+    decides which refcount-0 pages to evict.
+    """
+
+    def __init__(self, allocator, page_size: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        self._chain: Dict[bytes, int] = {}           # prefix digest -> page
+        self._key_of: Dict[int, bytes] = {}          # page -> its digest
+        self._ref: Dict[int, int] = {}               # page -> active users
+        self._lru: OrderedDict[int, None] = OrderedDict()   # refcount-0 pages
+
+    # ------------------------------------------------------------- stats
+
+    @property
+    def n_resident(self) -> int:
+        return len(self._key_of)
+
+    @property
+    def n_evictable(self) -> int:
+        return len(self._lru)
+
+    # ------------------------------------------------------------- match
+
+    def match(self, prompt_ids: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest chained page-aligned prefix of ``prompt_ids``.
+
+        Returns (pages, n_cached_tokens) and bumps each returned page's
+        refcount.  Reuse is capped at the last FULL page strictly before
+        the prompt end, so at least one prompt token is always re-prefilled
+        (the sampler needs the last token's logits).
+        """
+        P = self.page_size
+        limit = (len(prompt_ids) - 1) // P          # pages eligible for reuse
+        pages: List[int] = []
+        for key in _page_keys(prompt_ids, limit, P):
+            page = self._chain.get(key)
+            if page is None:
+                break
+            pages.append(page)
+        for p in pages:
+            self._acquire(p)
+        return pages, len(pages) * P
+
+    def _acquire(self, page: int) -> None:
+        if self._ref.get(page, 0) == 0:
+            self._lru.pop(page, None)
+        self._ref[page] = self._ref.get(page, 0) + 1
+
+    # ------------------------------------------------------------- insert
+
+    def insert(self, prompt_ids: Sequence[int], table: Sequence[int],
+               owner: int, n_matched_pages: int) -> int:
+        """Chain the full prompt pages of a just-prefilled sequence.
+
+        ``table``: the sequence's block-table prefix (page ids in prompt
+        order).  Pages ``[0, n_matched_pages)`` came from ``match`` and are
+        already shared; each later FULL page is transferred from ``owner``
+        to the cache and chained, stopping at the first digest that is
+        already chained to a different page (concurrent duplicate — stays
+        private).  Returns the total number of leading shared pages this
+        sequence now holds references to.
+        """
+        P = self.page_size
+        n_full = len(prompt_ids) // P
+        n_shared = n_matched_pages
+        keys = _page_keys(prompt_ids, n_full, P)
+        for i in range(n_matched_pages, n_full):
+            key = keys[i]
+            existing = self._chain.get(key)
+            page = int(table[i])
+            if existing is not None:
+                if existing != page:
+                    break                        # duplicate: keep private
+                self._acquire(page)              # re-chained same page
+            else:
+                self.allocator.transfer([page], owner, CACHE_OWNER)
+                self._chain[key] = page
+                self._key_of[page] = key
+                self._ref[page] = 1
+            n_shared = i + 1
+        return n_shared
+
+    # ------------------------------------------------------------ release
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; refcount-0 pages become evictable
+        (most recently released = last evicted)."""
+        for p in pages:
+            n = self._ref.get(p)
+            if n is None or n <= 0:
+                raise RuntimeError(f"release of unreferenced page {p}")
+            if n == 1:
+                self._ref[p] = 0
+                self._lru[p] = None
+                self._lru.move_to_end(p)
+            else:
+                self._ref[p] = n - 1
+
+    # -------------------------------------------------------------- evict
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` least-recently-used refcount-0 pages back to
+        the allocator.  Returns how many were freed."""
+        freed = 0
+        while freed < n and self._lru:
+            page, _ = self._lru.popitem(last=False)
+            key = self._key_of.pop(page)
+            del self._chain[key]
+            del self._ref[page]
+            self.allocator.free([page], CACHE_OWNER)
+            freed += 1
+        if freed:
+            METRICS.inc("engine.prefix_evicted_pages", freed)
+        return freed

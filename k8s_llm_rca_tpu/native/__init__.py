@@ -117,6 +117,9 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.pagealloc_free.restype = c.c_int32
     lib.pagealloc_free.argtypes = [c.c_void_p, c.POINTER(c.c_int32),
                                    c.c_int32, c.c_int64]
+    lib.pagealloc_transfer.restype = c.c_int32
+    lib.pagealloc_transfer.argtypes = [c.c_void_p, c.POINTER(c.c_int32),
+                                       c.c_int32, c.c_int64, c.c_int64]
     lib.pagealloc_pages_of.restype = c.c_int32
     lib.pagealloc_pages_of.argtypes = [c.c_void_p, c.c_int64,
                                        c.POINTER(c.c_int32), c.c_int32]
@@ -211,6 +214,15 @@ class NativePageAllocator:
         status = self._lib.pagealloc_free(self._h, arr,
                                           np.int32(len(pages)),
                                           np.int64(owner))
+        if status != OK:
+            self._raise(status)
+
+    def transfer(self, pages: Sequence[int], from_owner: int,
+                 to_owner: int) -> None:
+        arr = (ctypes.c_int32 * max(len(pages), 1))(*pages)
+        status = self._lib.pagealloc_transfer(
+            self._h, arr, np.int32(len(pages)), np.int64(from_owner),
+            np.int64(to_owner))
         if status != OK:
             self._raise(status)
 

@@ -51,6 +51,25 @@ class TestNativeAllocator:
             a.check()
             assert a.n_free == 7
 
+
+    def test_transfer_parity_with_python(self):
+        for cls in (PageAllocator, native.NativePageAllocator):
+            a = cls(8)
+            pages = a.alloc(3, owner=1)
+            a.transfer(pages[:2], from_owner=1, to_owner=-2)
+            assert sorted(a.pages_of(-2)) == sorted(pages[:2])
+            assert sorted(a.pages_of(1)) == sorted(pages[2:])
+            with pytest.raises(AllocatorError):      # wrong from_owner
+                a.transfer(pages[:1], from_owner=1, to_owner=-2)
+            with pytest.raises(AllocatorError):      # trash page
+                a.transfer([0], from_owner=1, to_owner=-2)
+            a.free(pages[:2], owner=-2)
+            with pytest.raises(AllocatorError):      # free page transfer
+                a.transfer(pages[:1], from_owner=-2, to_owner=1)
+            a.free(pages[2:], owner=1)
+            a.check()
+            assert a.n_free == 7
+
     def test_interleaved_sequence_parity(self):
         """Drive both allocators through the same random alloc/free
         schedule; free-list order may differ, but counts and failures

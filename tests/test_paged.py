@@ -17,6 +17,7 @@ from k8s_llm_rca_tpu.engine.paged import (
     TRASH_PAGE, AllocatorError, OutOfPages, PageAllocator,
     PagedInferenceEngine, init_paged_cache, paged_decode_step, paged_prefill,
 )
+from k8s_llm_rca_tpu.engine.prefix import PrefixCache
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
 
@@ -115,9 +116,13 @@ class TestPagedEngine:
     def _engine(self, **kw):
         cfg = TINY.replace(max_seq_len=64)
         params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        # prefix_cache off: these tests pin exact page counts and engineer
+        # pool-exhaustion scenarios; sharing would shift the arithmetic.
+        # TestPrefixCaching covers the cache-on behavior.
         defaults = dict(max_batch=4, max_seq_len=64, page_size=8,
                         num_pages=64, prefill_buckets=(16, 32, 64),
-                        max_new_tokens=8, temperature=0.0)
+                        max_new_tokens=8, temperature=0.0,
+                        prefix_cache=False)
         defaults.update(kw)
         ecfg = EngineConfig(**defaults)
         tok = get_tokenizer()
@@ -237,3 +242,120 @@ class TestPreemptionPolicy:
         assert res.finish_reason == "stop"
         assert res.text == "ab"           # trimmed at the spanning stop string
         paged.allocator.check()
+
+
+class TestPrefixCaching:
+    """Prefix-cache behavior (engine/prefix.py): KV reuse across sequences
+    sharing a prompt prefix, refcounts, eviction under pressure."""
+
+    def _engine(self, **kw):
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        defaults = dict(max_batch=4, max_seq_len=64, page_size=8,
+                        num_pages=64, prefill_buckets=(16, 32, 64),
+                        max_new_tokens=8, temperature=0.0,
+                        prefix_cache=True)
+        defaults.update(kw)
+        ecfg = EngineConfig(**defaults)
+        tok = get_tokenizer()
+        return PagedInferenceEngine(cfg, ecfg, params, tok,
+                                    use_kernel=False), tok, cfg, params
+
+    def test_unit_match_insert_release_evict(self):
+        a = PageAllocator(16)
+        pc = PrefixCache(a, page_size=4)
+        prompt = list(range(1, 12))                    # 11 tokens -> 2 full pages
+        pages = a.alloc(2, owner=7)
+        assert pc.match(prompt) == ([], 0)
+        n_shared = pc.insert(prompt, pages, owner=7, n_matched_pages=0)
+        assert n_shared == 2 and pc.n_resident == 2 and pc.n_evictable == 0
+        # a second prompt sharing the first 8 tokens: both full pages hit
+        other = prompt[:8] + [99, 98, 97]
+        got, n = pc.match(other)
+        assert n == 8 and got == pages
+        # a third sharing only the first page's tokens
+        third = prompt[:4] + [77, 76, 75, 74, 73]
+        got3, n3 = pc.match(third)
+        assert n3 == 4 and got3 == [pages[0]]
+        pc.release(got3)
+        pc.release(got)
+        pc.release(pages)
+        assert pc.n_evictable == 2
+        assert pc.evict(10) == 2
+        a.check()
+        assert a.n_free == 15                 # everything back in the pool
+
+    def test_second_submit_skips_cached_prefill(self):
+        from k8s_llm_rca_tpu.utils.logging import METRICS
+
+        eng, tok, _, _ = self._engine()
+        prompt = tok.encode("kubelet failed to pull image from registry "
+                            "backoff error", add_bos=True)
+        assert len(prompt) > 16                        # > 2 pages of 8
+        base_hits = METRICS.counters.get("engine.prefix_hit_tokens", 0)
+        r1 = eng.generate([prompt], max_new_tokens=4)[0]
+        assert METRICS.counters.get("engine.prefix_hit_tokens", 0) == base_hits
+        r2 = eng.generate([list(prompt)], max_new_tokens=4)[0]
+        hit = METRICS.counters.get("engine.prefix_hit_tokens", 0) - base_hits
+        assert hit == (len(prompt) - 1) // 8 * 8       # full pages re-used
+        assert r2.token_ids == r1.token_ids            # greedy: identical
+        eng.allocator.check()
+        # cached pages stay resident, everything else returned
+        assert eng.allocator.n_free + eng.prefix_cache.n_resident == 63
+        assert eng.prefix_cache.n_evictable == eng.prefix_cache.n_resident
+
+    def test_shared_prefix_matches_uncached_output(self):
+        eng, tok, cfg, params = self._engine()
+        off, _, _, _ = self._engine(prefix_cache=False)
+        common = tok.encode("incident: pod crashloop in namespace redis ",
+                            add_bos=True)
+        suffixes = ["why is it failing", "give the root cause",
+                    "what should we check"]
+        prompts = [common + tok.encode(s) for s in suffixes]
+        # warm the cache with the common prefix, then submit the variants
+        eng.generate([prompts[0]], max_new_tokens=4)
+        got = eng.generate(prompts, max_new_tokens=6)
+        ref = off.generate(prompts, max_new_tokens=6)
+        for g, r in zip(got, ref):
+            assert g.token_ids == r.token_ids, (g.token_ids, r.token_ids)
+        eng.allocator.check()
+
+    def test_eviction_under_pressure(self):
+        # small pool: cached pages must be evicted (not deadlock) when new
+        # sequences need the space
+        eng, tok, _, _ = self._engine(num_pages=9, max_batch=2,
+                                      prefill_buckets=(16,))
+        for i in range(6):
+            prompt = tok.encode(f"unique incident number {i} pod oom",
+                                add_bos=True)
+            res = eng.generate([prompt], max_new_tokens=4)
+            assert len(res) == 1
+        eng.allocator.check()
+        assert eng.allocator.n_free + eng.prefix_cache.n_resident == 8
+
+    def test_refcount_protects_in_use_pages(self):
+        a = PageAllocator(8)
+        pc = PrefixCache(a, page_size=4)
+        prompt = list(range(1, 10))
+        pages = a.alloc(2, owner=1)
+        pc.insert(prompt, pages, owner=1, n_matched_pages=0)
+        # still referenced by owner 1: nothing evictable
+        assert pc.evict(10) == 0
+        got, n = pc.match(prompt)                      # second user
+        assert got == pages[:2] and n == 8
+        pc.release(pages)                              # owner 1 done
+        assert pc.evict(10) == 0                       # owner 2 still holds
+        pc.release(got)
+        assert pc.evict(10) == 2
+        a.check()
+
+    def test_preemption_resume_with_shared_pages(self):
+        # pool under pressure with identical prompts: preempted sequences
+        # resume via the cache without corrupting refcounts
+        eng, tok, _, _ = self._engine(num_pages=12, max_batch=3,
+                                      max_new_tokens=16)
+        prompts = [tok.encode("a b c d e f g h i j k l m n o p",
+                              add_bos=True) for _ in range(3)]
+        results = eng.generate(prompts, max_new_tokens=16)
+        assert len(results) == 3
+        eng.allocator.check()
