@@ -36,21 +36,26 @@ REFERENCE_TOKENS_PER_S = 100.0   # 500-token completions / 5 s polling floor
 
 
 def pick_config():
-    """Largest preset that fits the local chip; TINY on CPU-only hosts."""
+    """Largest preset that fits the local chip; TINY on CPU-only hosts.
+
+    Returns (model_cfg, batch, prompt_len, decode_steps, quantize)."""
     dev = jax.devices()[0]
     if dev.platform != "tpu":
-        return TINY.replace(name="bench-tiny"), 8, 64, 128
-    # one chip (~16G HBM): TinyLlama-1.1B bf16 ~2.2G weights; the merged-dim
-    # KV cache ([..., n_kv*d], models/llama.KVCache) holds batch=192 at
-    # seq 1280 in ~5.5G, and decode is latency-bound on this chip, so
+        return TINY.replace(name="bench-tiny"), 8, 64, 128, False
+    # one chip (~16G HBM): TinyLlama-1.1B int8 ~1.1G weights; the merged-dim
+    # KV cache ([..., n_kv*d], models/llama.KVCache) holds batch=256 at
+    # seq 1280 in ~7.4G, and decode is latency-bound on this chip, so
     # throughput scales ~linearly with batch up to the HBM ceiling.
     # max_seq must hold prompt + warmup scan + measured scan (128 + 2*512).
     cfg = MODEL_REGISTRY["tinyllama-1.1b"].replace(max_seq_len=1280)
-    return cfg, 192, 128, 512
+    return cfg, 256, 128, 512, True
 
 
-def bench_decode(cfg, batch, prompt_len, decode_steps):
+def bench_decode(cfg, batch, prompt_len, decode_steps, quantize=False):
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    if quantize:
+        from k8s_llm_rca_tpu.models.quant import quantize_params
+        params = quantize_params(params)
     cache = llama.init_cache(cfg, batch, cfg.max_seq_len)
     tok = get_tokenizer(vocab_size=cfg.vocab_size)
 
@@ -118,9 +123,9 @@ def bench_rca_p50():
 
 
 def main():
-    cfg, batch, prompt_len, decode_steps = pick_config()
+    cfg, batch, prompt_len, decode_steps, quantize = pick_config()
     decode_tps, prefill_tps = bench_decode(cfg, batch, prompt_len,
-                                           decode_steps)
+                                           decode_steps, quantize)
     try:
         p50 = bench_rca_p50()
     except Exception:
@@ -131,6 +136,7 @@ def main():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(decode_tps / REFERENCE_TOKENS_PER_S, 2),
         "model": cfg.name,
+        "weights": "int8" if quantize else "bf16",
         "batch": batch,
         "prefill_tokens_per_s": round(prefill_tps, 2),
         "rca_p50_incident_s": round(p50, 4) if p50 is not None else None,

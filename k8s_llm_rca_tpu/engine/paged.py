@@ -41,6 +41,7 @@ from k8s_llm_rca_tpu.engine.sampling import (
     SamplingParams, sample_tokens, sample_tokens_masked,
 )
 from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.models.quant import dq, gather_rows
 from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.paged_attention import (
     paged_attention, paged_attention_xla,
@@ -232,7 +233,7 @@ def paged_prefill_chunk(cfg: ModelConfig, params, k_pages, v_pages,
 
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = prefix_len + jnp.arange(c_pad)[None, :]          # [1, C]
-    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
 
     # causal + validity mask in absolute positions (static shapes)
     q_pos = prefix_len + jnp.arange(c_pad)                       # [C]
@@ -255,7 +256,7 @@ def paged_prefill_chunk(cfg: ModelConfig, params, k_pages, v_pages,
         attn = _chunk_attention(cfg, q,
                                 jnp.concatenate([kp, k], axis=1),
                                 jnp.concatenate([vp, v], axis=1), mask)
-        x = x + attn.reshape(1, c_pad, cfg.q_dim) @ layer["wo"]
+        x = x + attn.reshape(1, c_pad, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + llama._mlp(cfg, layer, hm)
         ks.append(k[0].reshape(n_chunk_pages, page_size, cfg.kv_dim))
@@ -284,7 +285,7 @@ def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
     page_size = k_pages.shape[2]
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = lengths[:, None]
-    x = params["embedding"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens[:, None]).astype(jnp.dtype(cfg.dtype))
 
     page_idx = lengths // page_size
     page_ids = jnp.take_along_axis(
@@ -306,7 +307,7 @@ def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
         k_pages = k_pages.at[li].set(kp)
         v_pages = v_pages.at[li].set(vp)
         attn = attn_fn(q[:, 0], kp, vp, lengths + 1, block_tables)
-        x = x + attn.reshape(b, 1, cfg.q_dim) @ layer["wo"]
+        x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + llama._mlp(cfg, layer, hm)
 

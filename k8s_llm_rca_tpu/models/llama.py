@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from k8s_llm_rca_tpu.config import ModelConfig
+from k8s_llm_rca_tpu.models.quant import dq, gather_rows
 from k8s_llm_rca_tpu.ops.attention import causal_attention, decode_attention
 from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.rope import apply_rope, rope_frequencies
@@ -133,9 +134,9 @@ def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
          angles: jnp.ndarray, positions: jnp.ndarray):
     """x [B, S, H] -> q [B, S, n_heads, d], k/v [B, S, n_kv, d] (roped q,k)."""
     b, s, _ = x.shape
-    q = (x @ layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (x @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (x @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = (x @ dq(layer["wq"])).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (x @ dq(layer["wk"])).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (x @ dq(layer["wv"])).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, angles, positions)
     k = apply_rope(k, angles, positions)
     return q, k, v
@@ -144,9 +145,9 @@ def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
 def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.n_experts > 0:
         return _moe_mlp(cfg, layer, x)
-    gate = jax.nn.silu(x @ layer["w_gate"])
-    up = x @ layer["w_up"]
-    return (gate * up) @ layer["w_down"]
+    gate = jax.nn.silu(x @ dq(layer["w_gate"]))
+    up = x @ dq(layer["w_up"])
+    return (gate * up) @ dq(layer["w_down"])
 
 
 def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -160,16 +161,16 @@ def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
     """
     b, s, h = x.shape
     e, k = cfg.n_experts, cfg.n_experts_per_tok
-    router_logits = (x @ layer["router"]).astype(jnp.float32)      # [B,S,E]
+    router_logits = (x @ dq(layer["router"])).astype(jnp.float32)   # [B,S,E]
     topv, topi = jax.lax.top_k(router_logits, k)                   # [B,S,k]
     weights = jax.nn.softmax(topv, axis=-1)                        # [B,S,k]
     # scatter the top-k weights back to a dense [B,S,E] map
     onehot = jax.nn.one_hot(topi, e, dtype=jnp.float32)            # [B,S,k,E]
     dense_w = jnp.einsum("bske,bsk->bse", onehot, weights)         # [B,S,E]
 
-    gate = jax.nn.silu(jnp.einsum("bsh,ehi->bsei", x, layer["w_gate"]))
-    up = jnp.einsum("bsh,ehi->bsei", x, layer["w_up"])
-    per_expert = jnp.einsum("bsei,eih->bseh", gate * up, layer["w_down"])
+    gate = jax.nn.silu(jnp.einsum("bsh,ehi->bsei", x, dq(layer["w_gate"])))
+    up = jnp.einsum("bsh,ehi->bsei", x, dq(layer["w_up"]))
+    per_expert = jnp.einsum("bsei,eih->bseh", gate * up, dq(layer["w_down"]))
     return jnp.einsum("bseh,bse->bsh", per_expert,
                       dense_w.astype(x.dtype))
 
@@ -179,7 +180,7 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens):
     q, k, v = _qkv(cfg, layer, h, angles, positions)
     attn = causal_attention(q, k, v, seq_lens)
     b, s, _, _ = attn.shape
-    x = x + attn.reshape(b, s, cfg.q_dim) @ layer["wo"]
+    x = x + attn.reshape(b, s, cfg.q_dim) @ dq(layer["wo"])
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     x = x + _mlp(cfg, layer, h)
     return x, k, v
@@ -188,7 +189,7 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens):
 def _logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
-    return jnp.einsum("bsh,vh->bsv", x, head).astype(jnp.float32)
+    return jnp.einsum("bsh,vh->bsv", x, dq(head)).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -204,7 +205,7 @@ def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
         seq_lens = jnp.full((b,), s, jnp.int32)
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
     for layer in params["layers"]:
         x, _, _ = _block_prefill(cfg, layer, x, angles, positions, seq_lens)
     return _logits(cfg, params, x)
@@ -225,7 +226,7 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = jnp.arange(s_pad)[None, :]
     seq_lens = jnp.asarray(length).reshape(1)
-    x = params["embedding"][tokens].astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
 
     ks, vs = [], []
     for layer in params["layers"]:
@@ -280,7 +281,7 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
     b = tokens.shape[0]
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = lengths[:, None]                       # [B, 1]
-    x = params["embedding"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens[:, None]).astype(jnp.dtype(cfg.dtype))
 
     s_max = cache.max_seq_len
     new_ks, new_vs = [], []
@@ -297,7 +298,7 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
             q, k_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
             v_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
             lengths + 1)
-        x = x + attn.reshape(b, 1, cfg.q_dim) @ layer["wo"]
+        x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, layer, hm)
 

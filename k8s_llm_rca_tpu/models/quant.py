@@ -1,0 +1,106 @@
+"""Weight-only int8 quantization (per-channel symmetric).
+
+Decode is dominated by streaming weights from HBM; storing matmul weights
+as int8 with a per-output-channel scale halves that traffic (and model
+HBM footprint, freeing pages/slots for the KV cache) while activations
+stay bf16.  Dequantization is expressed as ``convert * scale`` right at
+the use site so XLA fuses it into the consuming matmul instead of
+materializing a dense bf16 copy.
+
+The reference has no quantization (no model in-repo at all — its compute
+is remote GPT-4, reference common/openai_generic_assistant.py:45-51);
+SURVEY §7 layer 3 lists the int8 hook as a build component.
+
+Usage:
+    params_q = quantize_params(params)          # int8 leaves, 1-D kept
+    logits = llama.forward(cfg, params_q, toks) # model code calls dq()
+
+Every weight consumer in models/llama.py goes through ``dq``/
+``gather_rows``, which pass plain arrays straight through — quantized and
+full-precision params run the same model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantTensor(NamedTuple):
+    """int8 weight + broadcast-ready per-channel scale (keepdims shape)."""
+
+    q: jnp.ndarray        # int8, original shape
+    scale: jnp.ndarray    # compute dtype, shape = 1s except the channel axis
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def quantize(w: jnp.ndarray, axis: int = -1,
+             compute_dtype: Optional[jnp.dtype] = None) -> QuantTensor:
+    """Symmetric per-channel int8: scale = max|w| / 127 along all axes
+    except ``axis`` (the output-channel axis whose scale survives)."""
+    compute_dtype = compute_dtype or w.dtype
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
+                   keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QuantTensor(q=q.astype(jnp.int8),
+                       scale=scale.astype(compute_dtype))
+
+
+def dq(w: Any) -> jnp.ndarray:
+    """Dequantize a QuantTensor; pass plain arrays through unchanged."""
+    if isinstance(w, QuantTensor):
+        return w.q.astype(w.scale.dtype) * w.scale
+    return w
+
+
+def gather_rows(w: Any, idx: jnp.ndarray) -> jnp.ndarray:
+    """Row gather (embedding lookup) without materializing the dense
+    dequantized table: gathers int8 rows and their row scales.  Requires
+    the table to be quantized with axis=0 (per-row), which is also the
+    right channel axis for its use as the tied LM head."""
+    if isinstance(w, QuantTensor):
+        # fail loudly on a per-column table: scale[idx] would be an
+        # out-of-bounds gather that JAX silently clamps to row 0
+        assert w.scale.shape[0] == w.q.shape[0], (
+            f"gather_rows needs per-row scales (axis=0 quantization); got "
+            f"scale {w.scale.shape} for table {w.q.shape}")
+        return w.q[idx].astype(w.scale.dtype) * w.scale[idx]
+    return w[idx]
+
+
+# weights quantized per-row (axis 0): channel axis is the first dim
+_ROW_QUANT = ("embedding", "lm_head")
+
+
+def quantize_params(params: Any, compute_dtype=jnp.bfloat16) -> Any:
+    """Quantize every rank>=2 weight of a model param tree.
+
+    1-D tensors (norm gains, biases) and integer arrays stay as-is.
+    ``embedding``/``lm_head`` use per-row scales (valid for both the
+    token gather and the output projection, whose channel axis is the
+    vocab row); everything else uses per-output-column scales (last axis).
+    """
+    def _quantize_entry(path, w):
+        if isinstance(w, QuantTensor):          # idempotent
+            return w
+        if not isinstance(w, jnp.ndarray) or w.ndim < 2:
+            return w
+        if not jnp.issubdtype(w.dtype, jnp.floating):
+            return w
+        axis = 0 if any(str(k) in repr(path) for k in _ROW_QUANT) else -1
+        return quantize(w, axis=axis, compute_dtype=compute_dtype)
+
+    return jax.tree_util.tree_map_with_path(
+        _quantize_entry, params,
+        is_leaf=lambda x: isinstance(x, QuantTensor))

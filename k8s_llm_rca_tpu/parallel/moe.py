@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from k8s_llm_rca_tpu.models.quant import dq
+
 
 def _route_exact(x, router_w, n_experts: int, top_k: int, capacity: int):
     """Dispatch/combine with a SINGLE shared cumsum across the k lanes so
@@ -88,7 +90,7 @@ def expert_parallel_moe(x: jnp.ndarray, layer: Dict, mesh: Mesh,
     leading expert dim.  Returns [B, S, H].
     """
     b, s, h = x.shape
-    e = layer["router"].shape[1]
+    e = layer["router"].shape[-1]
     # tokens shard over BOTH axes so each expert-axis peer routes a distinct
     # token shard (otherwise the exchange carries P identical slot copies)
     n_tok_shards = mesh.shape[data_axis] * mesh.shape[expert_axis]
@@ -111,5 +113,6 @@ def expert_parallel_moe(x: jnp.ndarray, layer: Dict, mesh: Mesh,
                   P(expert_axis, None, None)),
         out_specs=tok_spec,
         check_vma=False,
-    )(flat, layer["router"], layer["w_gate"], layer["w_up"], layer["w_down"])
+    )(flat, dq(layer["router"]), dq(layer["w_gate"]), dq(layer["w_up"]),
+      dq(layer["w_down"]))
     return out.reshape(b, s, h)
