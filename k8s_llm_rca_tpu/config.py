@@ -194,6 +194,10 @@ class EngineConfig:
     seed: int = 0
     # decode loop
     decode_chunk: int = 16             # device steps per host sync in scan mode
+    # n-gram speculative decoding (greedy only; engine/speculative.py):
+    # k drafts verified per tick by one multi-token decode.  0 = off.
+    speculative_k: int = 0
+    speculative_ngram: int = 3
     # host-side runtime: use the C++ components (page allocator, grammar
     # mask engine) when a toolchain can build them; pure-Python fallback
     # is behavior-identical

@@ -1,2 +1,15 @@
 from k8s_llm_rca_tpu.engine.engine import InferenceEngine, SequenceResult  # noqa: F401
 from k8s_llm_rca_tpu.engine.sampling import sample_tokens, SamplingParams  # noqa: F401
+
+
+def make_engine(model_cfg, engine_cfg, params, tokenizer, **kw):
+    """Engine factory: PagedInferenceEngine when ``engine_cfg.paged`` (page
+    pool + preemption + prefix caching), else the contiguous-slot engine.
+    Both expose the same EngineBase surface."""
+    if engine_cfg.paged:
+        from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+
+        return PagedInferenceEngine(model_cfg, engine_cfg, params, tokenizer,
+                                    **kw)
+    # forward kw so an unsupported kwarg raises instead of vanishing
+    return InferenceEngine(model_cfg, engine_cfg, params, tokenizer, **kw)

@@ -287,8 +287,19 @@ class InferenceEngine(EngineBase):
 
         self._prefill = jax.jit(llama.prefill, static_argnums=0)
         self._decode = jax.jit(llama.decode_step, static_argnums=0)
+        def _verify_step(cfg, params, cache, tokens, lengths):
+            cache, logits = llama.decode_multi(cfg, params, cache, tokens,
+                                               lengths)
+            # greedy choices computed on device: the [B, T] int transfer is
+            # 32000x smaller than the logits; full logits leave the device
+            # only for grammar slots (fetched lazily by the caller)
+            return cache, jnp.argmax(logits, axis=-1), logits
+
+        self._decode_multi = jax.jit(_verify_step, static_argnums=0)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
+        self._prompts: Dict[int, List[int]] = {}   # seq_id -> prompt (for
+        # n-gram draft lookup; dropped at retirement)
 
         self._buckets = tuple(
             s for s in sorted(set(engine_cfg.prefill_buckets))
@@ -296,6 +307,9 @@ class InferenceEngine(EngineBase):
         ) or (engine_cfg.max_seq_len,)
 
     # ------------------------------------------------------------------ api
+
+    def _register(self, seq_id: int, prompt_ids: List[int]) -> None:
+        self._prompts[seq_id] = list(prompt_ids)
 
     def step(self) -> List[SequenceResult]:
         """One engine tick: admit pending into free slots, then one decode
@@ -306,6 +320,10 @@ class InferenceEngine(EngineBase):
             if early is not None:        # first sampled token already terminal
                 finished.append(early)
         if not self._active:
+            return finished
+
+        if self._speculation_applies():
+            finished.extend(self._speculative_tick())
             return finished
 
         active_slots = list(self._active)
@@ -394,6 +412,7 @@ class InferenceEngine(EngineBase):
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)
         self._free_slots.append(slot)
+        self._prompts.pop(st.seq_id, None)
         text = self._final_text(st.generated, reason, st.stop_strings)
         return SequenceResult(
             seq_id=st.seq_id,
@@ -403,6 +422,105 @@ class InferenceEngine(EngineBase):
             prompt_tokens=st.prompt_tokens,
             completion_tokens=len(st.generated),
         )
+
+    # --------------------------------------------- speculative decoding
+
+    def _speculation_applies(self) -> bool:
+        """Speculate only when exact-equivalence is guaranteed and every
+        slot has cache room for the full T = k+1 token write."""
+        k = self.engine_cfg.speculative_k
+        if k <= 0 or self.engine_cfg.temperature != 0.0:
+            return False
+        t = k + 1
+        lengths = np.asarray(self.lengths)
+        return all(int(lengths[s]) + t <= self.engine_cfg.max_seq_len
+                   for s in self._active)
+
+    def _greedy_with_grammar(self, st: _Active, greedy_token: int,
+                             logits_row) -> int:
+        """The token a plain greedy tick would commit: grammar force /
+        allow-mask applied to argmax, identically to the regular path.
+        ``logits_row`` is fetched lazily — only grammar slots pay for it."""
+        if st.grammar is None:
+            return greedy_token
+        c = st.grammar.constraint(self._budget_remaining(st))
+        if c.force is not None:
+            return c.force
+        if c.allow is not None:
+            masked = np.where(np.asarray(c.allow), np.asarray(logits_row),
+                              -np.inf)
+            return int(np.argmax(masked))
+        return greedy_token
+
+    def _speculative_tick(self) -> List[SequenceResult]:
+        """One verification tick: draft via n-gram lookup, score all draft
+        positions in one decode_multi, commit the longest agreeing prefix
+        plus one bonus token per slot.  Greedy-exact: commits are the same
+        tokens the regular tick would produce, just more per tick."""
+        from k8s_llm_rca_tpu.engine.speculative import ngram_draft
+
+        k_spec = self.engine_cfg.speculative_k
+        t = k_spec + 1
+        b = self.engine_cfg.max_batch
+        active_slots = list(self._active)
+
+        tokens_in = np.zeros((b, t), np.int32)
+        drafts: Dict[int, List[int]] = {}
+        cur_host = np.asarray(self.cur_tokens)
+        for slot in active_slots:
+            st = self._active[slot]
+            ctx = self._prompts.get(st.seq_id, []) + st.generated
+            d = ngram_draft(ctx, self.engine_cfg.speculative_ngram, k_spec)
+            drafts[slot] = d
+            tokens_in[slot, 0] = cur_host[slot]
+            tokens_in[slot, 1:1 + len(d)] = d
+
+        with METRICS.timer("engine.decode_step"):
+            self.cache, greedy, logits = self._decode_multi(
+                self.model_cfg, self.params, self.cache,
+                jnp.asarray(tokens_in), self.lengths)
+            greedy_host = np.asarray(greedy)                      # [B, T]
+        # full logits cross the host boundary only when a grammar slot
+        # needs a masked argmax (32000x smaller transfer otherwise)
+        need_logits = any(self._active[s].grammar is not None
+                          for s in active_slots)
+        logits_host = np.asarray(logits) if need_logits else None
+
+        finished: List[SequenceResult] = []
+        lengths_host = np.asarray(self.lengths).copy()
+        next_cur = cur_host.copy()
+        for slot in active_slots:
+            st = self._active[slot]
+            draft = drafts[slot]
+            committed = 0
+            reason = None
+            for j in range(len(draft) + 1):
+                token = self._greedy_with_grammar(
+                    st, int(greedy_host[slot, j]),
+                    logits_host[slot, j] if logits_host is not None else None)
+                st.generated.append(token)
+                if st.grammar is not None:
+                    st.grammar.advance(token)
+                committed += 1
+                # cache now holds j+1 more tokens than before this commit:
+                # tokens_in[0..j] are written; token itself is written on a
+                # LATER tick (same as the regular path's current token)
+                reason = self._finish_reason(st, token,
+                                             int(lengths_host[slot]) + j + 1)
+                accepted = (reason is None and j < len(draft)
+                            and token == draft[j])
+                if not accepted:
+                    break
+            METRICS.inc("engine.decode_tokens", committed)
+            METRICS.inc("engine.spec_drafted", len(draft))
+            METRICS.inc("engine.spec_accepted", max(0, committed - 1))
+            lengths_host[slot] += committed
+            next_cur[slot] = st.generated[-1]
+            if reason is not None:
+                finished.append(self._retire(slot, reason))
+        self.lengths = jnp.asarray(lengths_host)
+        self.cur_tokens = jnp.asarray(next_cur)
+        return finished
 
 
 # ---------------------------------------------------------------------------

@@ -340,6 +340,10 @@ class PagedInferenceEngine(EngineBase):
     def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
                  params, tokenizer: Tokenizer,
                  use_kernel: Optional[bool] = None):
+        if engine_cfg.speculative_k > 0:
+            raise ValueError(
+                "speculative decoding is implemented for the contiguous "
+                "InferenceEngine only (set paged=False or speculative_k=0)")
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params

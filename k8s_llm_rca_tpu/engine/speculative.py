@@ -1,0 +1,44 @@
+"""N-gram speculative decoding (prompt-lookup drafts).
+
+Draft tokens are proposed by matching the sequence's most recent n-gram
+against its own earlier context (prompt + generation) — no draft model.
+Verification runs ONE multi-token decode step (models/llama.decode_multi)
+scoring all draft positions at once; the longest prefix of drafts that
+matches the model's own greedy choice is accepted, plus one bonus token
+from the first mismatching position.  Output is therefore IDENTICAL to
+plain greedy decoding — speculation only changes how many tokens each
+engine tick commits.
+
+Why it fits this workload: decode ticks are latency-bound (a fixed-cost
+sweep over the layer stack), so scoring K+1 positions instead of 1 is
+nearly free, and the RCA stages emit highly repetitive structured output
+(JSON field names, kinds, kubectl phrases that already appear in the
+prompt), which is exactly where prompt-lookup acceptance is high.  The
+reference has no decoding loop to accelerate at all (tokens stream from
+the OpenAI server, reference common/openai_generic_assistant.py:92-115).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+def ngram_draft(context: Sequence[int], n: int, k: int) -> List[int]:
+    """Propose up to ``k`` draft tokens by prompt lookup.
+
+    Finds the most recent earlier occurrence of the last ``n`` tokens of
+    ``context`` and returns the tokens that followed it.  Empty when the
+    n-gram has no earlier occurrence (caller falls back to plain decode).
+    """
+    if n <= 0 or k <= 0 or len(context) <= n:
+        return []
+    pattern = list(context[-n:])
+    # scan right-to-left over earlier windows; the most recent prior
+    # occurrence predicts the continuation best
+    for start in range(len(context) - n - 1, -1, -1):
+        if list(context[start:start + n]) == pattern:
+            cont = list(context[start + n:start + n + k])
+            if cont:
+                return cont
+            return []
+    return []

@@ -27,7 +27,9 @@ import jax.numpy as jnp
 
 from k8s_llm_rca_tpu.config import ModelConfig
 from k8s_llm_rca_tpu.models.quant import dq, gather_rows
-from k8s_llm_rca_tpu.ops.attention import causal_attention, decode_attention
+from k8s_llm_rca_tpu.ops.attention import (
+    causal_attention, decode_attention, decode_attention_multi,
+)
 from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -304,4 +306,58 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
 
     cache = KVCache(jnp.stack(new_ks), jnp.stack(new_vs))
     logits = _logits(cfg, params, x)[:, 0]             # [B, V]
+    return cache, logits
+
+
+def _write_tokens_kv(cache_layer: jnp.ndarray, kv_new: jnp.ndarray,
+                     lengths: jnp.ndarray) -> jnp.ndarray:
+    """Scatter T tokens' k/v per slot: cache [B, S, kv_dim], kv_new
+    [B, T, kv_dim], written at per-slot offsets lengths[b]..lengths[b]+T-1."""
+    def write_one(c, kv, pos):
+        return jax.lax.dynamic_update_slice(c, kv, (pos, 0))
+
+    return jax.vmap(write_one)(cache_layer, kv_new, lengths)
+
+
+def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
+                 tokens: jnp.ndarray, lengths: jnp.ndarray
+                 ) -> Tuple[KVCache, jnp.ndarray]:
+    """Multi-token decode step (speculative verification).
+
+    tokens [B, T]: tokens[b, 0] is slot b's current token (as in
+    decode_step) and tokens[b, 1:] are draft tokens to verify; lengths [B]
+    tokens already in the cache.  Writes all T tokens' KV at
+    lengths[b]..lengths[b]+T-1 and returns (cache', logits [B, T, V]) where
+    logits[b, i] scores the token AFTER tokens[b, i].
+
+    Rejected drafts need no cache rollback: attention masks by length, so
+    KV written past the accepted position is invisible until overwritten
+    by a later decode at that position.
+    """
+    b, t = tokens.shape
+    s_max = cache.max_seq_len
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = lengths[:, None] + jnp.arange(t)[None, :]       # [B, T]
+    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+
+    new_ks, new_vs = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, layer, h, angles, positions)        # [B,T,·,d]
+        k_cache = _write_tokens_kv(cache.k[li],
+                                   k.reshape(b, t, cfg.kv_dim), lengths)
+        v_cache = _write_tokens_kv(cache.v[li],
+                                   v.reshape(b, t, cfg.kv_dim), lengths)
+        new_ks.append(k_cache)
+        new_vs.append(v_cache)
+        attn = decode_attention_multi(
+            q, k_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            v_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            lengths + 1)
+        x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
+        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, layer, hm)
+
+    cache = KVCache(jnp.stack(new_ks), jnp.stack(new_vs))
+    logits = _logits(cfg, params, x)                            # [B, T, V]
     return cache, logits

@@ -43,12 +43,15 @@ class QuantTensor(NamedTuple):
         return self.q.ndim
 
 
-def quantize(w: jnp.ndarray, axis: int = -1,
+def quantize(w: jnp.ndarray, axis=-1,
              compute_dtype: Optional[jnp.dtype] = None) -> QuantTensor:
-    """Symmetric per-channel int8: scale = max|w| / 127 along all axes
-    except ``axis`` (the output-channel axis whose scale survives)."""
+    """Symmetric per-channel int8: scale = max|w| / 127 reduced over every
+    axis NOT in ``axis`` (an int or tuple of surviving channel axes —
+    e.g. (0, -1) for stacked expert weights, so each (expert, column)
+    pair gets its own scale instead of sharing across experts)."""
     compute_dtype = compute_dtype or w.dtype
-    reduce_axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    keep = {a % w.ndim for a in ((axis,) if isinstance(axis, int) else axis)}
+    reduce_axes = tuple(i for i in range(w.ndim) if i not in keep)
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
                    keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
@@ -98,7 +101,12 @@ def quantize_params(params: Any, compute_dtype=jnp.bfloat16) -> Any:
             return w
         if not jnp.issubdtype(w.dtype, jnp.floating):
             return w
-        axis = 0 if any(str(k) in repr(path) for k in _ROW_QUANT) else -1
+        if any(str(k) in repr(path) for k in _ROW_QUANT):
+            axis = 0                      # per-vocab-row (gather + lm head)
+        elif w.ndim >= 3:
+            axis = (0, -1)                # stacked experts: per (e, column)
+        else:
+            axis = -1                     # per output column
         return quantize(w, axis=axis, compute_dtype=compute_dtype)
 
     return jax.tree_util.tree_map_with_path(

@@ -74,18 +74,34 @@ def decode_attention(
     v_cache: jnp.ndarray,    # [B, S_max, n_kv, d]
     lengths: jnp.ndarray,    # [B] tokens valid in cache (incl. current)
 ) -> jnp.ndarray:
-    """Single-step decode attention over the slot cache.  [B, 1, n_heads, d]."""
+    """Single-step decode attention over the slot cache.  [B, 1, n_heads, d].
+
+    The T=1 case of ``decode_attention_multi`` (delegated so the two paths
+    cannot drift numerically)."""
+    return decode_attention_multi(q, k_cache, v_cache, lengths)
+
+
+def decode_attention_multi(
+    q: jnp.ndarray,          # [B, T, n_heads, d] queries at pos lengths-1+i
+    k_cache: jnp.ndarray,    # [B, S_max, n_kv, d]
+    v_cache: jnp.ndarray,    # [B, S_max, n_kv, d]
+    lengths: jnp.ndarray,    # [B] tokens valid incl. the FIRST query token
+) -> jnp.ndarray:
+    """Multi-token decode attention (speculative verification): query i of
+    slot b attends to cache positions < lengths[b] + i.  [B, T, n_heads, d].
+    """
     b, s_max, n_kv, d = k_cache.shape
-    n_heads = q.shape[2]
+    t, n_heads = q.shape[1], q.shape[2]
     n_rep = n_heads // n_kv
     k = repeat_kv(k_cache, n_rep)
     v = repeat_kv(v_cache, n_rep)
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale    # [B, H, 1, S_max]
-    k_pos = jnp.arange(s_max)[None, None, None, :]
-    mask = k_pos < lengths[:, None, None, None]
+                        k.astype(jnp.float32)) * scale    # [B, H, T, S_max]
+    k_pos = jnp.arange(s_max)[None, None, :]              # [1, 1, S]
+    limit = lengths[:, None, None] + jnp.arange(t)[None, :, None]  # [B, T, 1]
+    mask = (k_pos < limit)[:, None]                       # [B, 1, T, S]
     logits = jnp.where(mask, logits, NEG_INF)
     probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)

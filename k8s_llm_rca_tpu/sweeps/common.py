@@ -30,6 +30,14 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
                              f"{sorted(MODEL_REGISTRY)}")
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-seq-len", type=int, default=2048)
+    parser.add_argument("--paged", action="store_true",
+                        help="paged KV cache engine (preemption + prefix "
+                             "caching) instead of contiguous slots")
+    parser.add_argument("--int8", action="store_true",
+                        help="weight-only int8 quantization")
+    parser.add_argument("--weights", default=None,
+                        help="HF safetensors file/dir to load real weights "
+                             "from (default: random init)")
     parser.add_argument("--neo4j-meta", default=None,
                         help="bolt://host:port for a live metagraph "
                              "(default: canned in-memory fixture)")
@@ -46,15 +54,25 @@ def build_service(args) -> AssistantService:
     # engine backend: build the model + continuous-batching engine
     import jax
 
-    from k8s_llm_rca_tpu.engine import InferenceEngine
+    from k8s_llm_rca_tpu.engine import make_engine
     from k8s_llm_rca_tpu.models import llama
     from k8s_llm_rca_tpu.serve.backend import EngineBackend
 
     model_cfg = MODEL_REGISTRY.get(args.model, TINY)
-    params = llama.init_params(model_cfg, jax.random.PRNGKey(0))
-    engine = InferenceEngine(
+    if getattr(args, "weights", None):
+        from k8s_llm_rca_tpu.models.loader import load_llama
+
+        params = load_llama(model_cfg, args.weights)
+    else:
+        params = llama.init_params(model_cfg, jax.random.PRNGKey(0))
+    if getattr(args, "int8", False):
+        from k8s_llm_rca_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)
+    engine = make_engine(
         model_cfg,
-        EngineConfig(max_batch=args.max_batch, max_seq_len=args.max_seq_len),
+        EngineConfig(max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+                     paged=getattr(args, "paged", False)),
         params, tokenizer)
     return AssistantService(EngineBackend(engine))
 

@@ -153,3 +153,18 @@ def test_expert_parallel_moe_quantized(monkeypatch):
     assert np.isfinite(np.asarray(out_q)).all()
     corr = np.corrcoef(np.asarray(out_q).ravel(), np.asarray(ref).ravel())[0, 1]
     assert corr > 0.99
+
+
+def test_moe_experts_get_per_expert_scales():
+    # [E, H, I] expert stacks must not share one scale across experts
+    w = jnp.stack([jnp.ones((8, 16)) * 0.01,
+                   jnp.ones((8, 16)) * 10.0])      # outlier expert
+    qt = quantize(w, axis=(0, -1), compute_dtype=jnp.float32)
+    assert qt.scale.shape == (2, 1, 16)
+    np.testing.assert_allclose(np.asarray(dq(qt)), np.asarray(w),
+                               rtol=1e-2, atol=1e-4)
+    # and quantize_params picks that layout for 3-D weights
+    params = llama.init_params(TINY_MOE, jax.random.PRNGKey(0))
+    qp = quantize_params(params, compute_dtype=jnp.float32)
+    gate = qp["layers"][0]["w_gate"]
+    assert gate.scale.shape[0] == TINY_MOE.n_experts
