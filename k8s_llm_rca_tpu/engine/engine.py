@@ -263,7 +263,23 @@ class InferenceEngine(EngineBase):
         engine_cfg: EngineConfig,
         params,
         tokenizer: Tokenizer,
+        cp_mesh=None,
+        cp_seq_axis: str = "seq",
     ):
+        """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
+        then runs context-parallel ring attention over it (long-context
+        mode; the axis size must divide every prefill bucket and
+        max_seq_len, validated below).  Decode is unaffected (its per-step
+        KV is one token)."""
+        if cp_mesh is not None:
+            n_cp = cp_mesh.shape[cp_seq_axis]
+            bad = [s for s in tuple(engine_cfg.prefill_buckets)
+                   + (engine_cfg.max_seq_len,) if s % n_cp]
+            if bad:
+                raise ValueError(
+                    f"cp mesh axis '{cp_seq_axis}' size {n_cp} must divide "
+                    f"every prefill bucket and max_seq_len; offending "
+                    f"sizes: {bad}")
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params
@@ -285,7 +301,14 @@ class InferenceEngine(EngineBase):
         self._pending: List[_Pending] = []
         self._seq_counter = itertools.count()
 
-        self._prefill = jax.jit(llama.prefill, static_argnums=0)
+        if cp_mesh is not None:
+            def _prefill_cp(cfg, params, cache, toks, n, slot):
+                return llama.prefill_cp(cfg, params, cache, toks, n, slot,
+                                        cp_mesh, cp_seq_axis)
+
+            self._prefill = jax.jit(_prefill_cp, static_argnums=0)
+        else:
+            self._prefill = jax.jit(llama.prefill, static_argnums=0)
         self._decode = jax.jit(llama.decode_step, static_argnums=0)
         def _verify_step(cfg, params, cache, tokens, lengths):
             cache, logits = llama.decode_multi(cfg, params, cache, tokens,

@@ -177,15 +177,34 @@ def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
                       dense_w.astype(x.dtype))
 
 
-def _block_prefill(cfg, layer, x, angles, positions, seq_lens):
+def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
+                   attention_fn=None):
+    """One transformer block over a full sequence.  ``attention_fn``
+    defaults to masked causal attention; the context-parallel prefill
+    passes ring attention instead (same (q, k, v) -> out contract)."""
     h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q, k, v = _qkv(cfg, layer, h, angles, positions)
-    attn = causal_attention(q, k, v, seq_lens)
+    if attention_fn is None:
+        attn = causal_attention(q, k, v, seq_lens)
+    else:
+        attn = attention_fn(q, k, v)
     b, s, _, _ = attn.shape
     x = x + attn.reshape(b, s, cfg.q_dim) @ dq(layer["wo"])
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     x = x + _mlp(cfg, layer, h)
     return x, k, v
+
+
+def _write_prefill_kv(cfg: ModelConfig, cache: KVCache, new_k, new_v,
+                      slot) -> KVCache:
+    """Write one sequence's full-depth prefill KV into cache slot ``slot``
+    at sequence offset 0 (shared by the plain and CP prefill paths)."""
+    L, s_pad = new_k.shape[0], new_k.shape[1]
+    k_cache = jax.lax.dynamic_update_slice(
+        cache.k, new_k.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        cache.v, new_v.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
+    return KVCache(k_cache, v_cache)
 
 
 def _logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -251,14 +270,7 @@ def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
     (engine/engine.py buckets prompt lengths to keep recompiles bounded).
     """
     new_k, new_v, logits = prefill_kv(cfg, params, tokens, length)
-
-    # write [L, 1, S_pad, kv_dim] into the slot row at sequence offset 0
-    L, s_pad = new_k.shape[0], new_k.shape[1]
-    k_cache = jax.lax.dynamic_update_slice(
-        cache.k, new_k.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        cache.v, new_v.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
-    return KVCache(k_cache, v_cache), logits
+    return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 
 def _write_token_kv(cache_layer: jnp.ndarray, kv_new: jnp.ndarray,
@@ -361,3 +373,54 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
     cache = KVCache(jnp.stack(new_ks), jnp.stack(new_vs))
     logits = _logits(cfg, params, x)                            # [B, T, V]
     return cache, logits
+
+
+def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
+                  length: jnp.ndarray, mesh, seq_axis: str = "seq"
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Context-parallel prefill: ``prefill_kv`` with the sequence sharded
+    over ``mesh[seq_axis]`` and attention computed as ring attention
+    (parallel/ring_attention.py — KV blocks rotate over the ICI ring, the
+    [S, S] score matrix never materializes on one device).
+
+    The engine's long-context mode: prompts larger than one device's
+    activation budget prefill across the ring; the returned full-depth KV
+    is written into the cache exactly like the single-device path.  Right
+    padding is safe under pure causal masking (padded keys sit at
+    positions >= length, which no valid query attends to).
+
+    tokens [1, S_pad] with S_pad divisible by the axis size.  Returns
+    (new_k [L, S_pad, n_kv, d], new_v, logits [1, V]).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_llm_rca_tpu.parallel.ring_attention import ring_attention
+
+    _, s_pad = tokens.shape
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = jnp.arange(s_pad)[None, :]
+    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+    x = jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, P(None, seq_axis, None)))
+
+    ring = lambda q, k, v: ring_attention(q, k, v, mesh, seq_axis=seq_axis)
+    ks, vs = [], []
+    for layer in params["layers"]:
+        x, k, v = _block_prefill(cfg, layer, x, angles, positions,
+                                 seq_lens=None, attention_fn=ring)
+        ks.append(k[0])
+        vs.append(v[0])
+
+    last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+    logits = _logits(cfg, params, last)[:, 0]
+    return jnp.stack(ks), jnp.stack(vs), logits
+
+
+def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
+               tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
+               mesh, seq_axis: str = "seq") -> Tuple[KVCache, jnp.ndarray]:
+    """Context-parallel variant of ``prefill``: same cache-write contract,
+    ring-attention compute (see prefill_kv_cp)."""
+    new_k, new_v, logits = prefill_kv_cp(cfg, params, tokens, length, mesh,
+                                         seq_axis)
+    return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits

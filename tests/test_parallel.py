@@ -172,3 +172,67 @@ def test_tp_sharded_engine_matches_unsharded(cpu_devices):
         prompts, max_new_tokens=6)
     for r, g in zip(ref, got):
         assert r.token_ids == g.token_ids
+
+
+def test_cp_prefill_matches_single_device(seq_mesh):
+    """Ring-attention (context-parallel) prefill must produce the same KV
+    and last-token logits as the plain single-device prefill."""
+    from k8s_llm_rca_tpu.config import TINY
+
+    cfg = TINY
+    mesh = seq_mesh
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((1, 32), jnp.int32).at[0, :27].set(
+        jax.random.randint(jax.random.PRNGKey(1), (27,), 0, cfg.vocab_size))
+    length = jnp.int32(27)
+
+    ref_k, ref_v, ref_logits = llama.prefill_kv(cfg, params, tokens, length)
+    cp_k, cp_v, cp_logits = llama.prefill_kv_cp(cfg, params, tokens, length,
+                                                mesh)
+    np.testing.assert_allclose(np.asarray(cp_logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    # only positions < length matter (padded KV is never attended to)
+    np.testing.assert_allclose(np.asarray(cp_k[:, :27]),
+                               np.asarray(ref_k[:, :27]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(cp_v[:, :27]),
+                               np.asarray(ref_v[:, :27]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_engine_cp_prefill_matches_plain_engine(seq_mesh):
+    """InferenceEngine in context-parallel prefill mode emits the same
+    greedy tokens as the plain engine."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = seq_mesh
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod sandbox changed restarting", add_bos=True),
+               tok.encode("oom killed container", add_bos=True)]
+
+    ref = InferenceEngine(cfg, ecfg, params, tok).generate(
+        prompts, max_new_tokens=6)
+    got = InferenceEngine(cfg, ecfg, params, tok, cp_mesh=mesh).generate(
+        prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+
+
+def test_engine_cp_rejects_indivisible_buckets(seq_mesh):
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    ecfg = EngineConfig(max_batch=1, max_seq_len=64, prefill_buckets=(18,))
+    with pytest.raises(ValueError, match="must divide"):
+        InferenceEngine(cfg, ecfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                        get_tokenizer(vocab_size=cfg.vocab_size),
+                        cp_mesh=seq_mesh)
