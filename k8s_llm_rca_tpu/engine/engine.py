@@ -24,6 +24,7 @@ indices, which guards the silent-clamp semantics of dynamic_update_slice
 
 from __future__ import annotations
 
+import functools
 import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -41,6 +42,21 @@ from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
 log = get_logger(__name__)
+
+
+def flash_prefill_safe(params) -> bool:
+    """Whether inference prefill may use the Pallas flash kernel: TPU
+    backend and no multi-device (TP/EP) param sharding — pallas_call has
+    no SPMD partitioning rule, so a sharded run would silently replicate
+    attention on every device (and it has no VJP, but prefill is
+    inference-only here)."""
+    if jax.default_backend() != "tpu":
+        return False
+    for leaf in jax.tree.leaves(params):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and getattr(sharding, "num_devices", 1) > 1:
+            return False
+    return True
 
 
 @dataclass
@@ -308,7 +324,10 @@ class InferenceEngine(EngineBase):
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:
-            self._prefill = jax.jit(llama.prefill, static_argnums=0)
+            self._prefill = jax.jit(
+                functools.partial(llama.prefill,
+                                  use_flash=flash_prefill_safe(params)),
+                static_argnums=0)
         self._decode = jax.jit(llama.decode_step, static_argnums=0)
         def _verify_step(cfg, params, cache, tokens, lengths):
             cache, logits = llama.decode_multi(cfg, params, cache, tokens,

@@ -165,17 +165,19 @@ def init_paged_cache(cfg: ModelConfig, n_pages: int, page_size: int):
 
 def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
                   tokens: jnp.ndarray, length: jnp.ndarray,
-                  page_map: jnp.ndarray):
+                  page_map: jnp.ndarray, use_flash: bool = False):
     """Prefill ONE sequence, scattering its KV into ``page_map`` pages.
 
     tokens [1, S_pad] with S_pad a multiple of page_size; page_map
     [S_pad // page_size] int32 page ids (entries past the prompt's pages
-    must be TRASH_PAGE).  Returns (k_pages', v_pages', logits [1, V]).
+    must be TRASH_PAGE).  ``use_flash``: see llama.prefill_kv.  Returns
+    (k_pages', v_pages', logits [1, V]).
     """
     _, s_pad = tokens.shape
     page_size = k_pages.shape[2]
     assert s_pad % page_size == 0, (s_pad, page_size)
-    new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length)
+    new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length,
+                                            use_flash)
 
     n_seq_pages = s_pad // page_size
 
@@ -389,8 +391,14 @@ class PagedInferenceEngine(EngineBase):
         # every tick copies the whole pool and peak HBM doubles.  (CPU has
         # no donation support and would warn on every compile, so gate it.)
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
-        self._prefill = jax.jit(paged_prefill, static_argnums=0,
-                                donate_argnums=donate)
+        import functools
+
+        from k8s_llm_rca_tpu.engine.engine import flash_prefill_safe
+
+        self._prefill = jax.jit(
+            functools.partial(paged_prefill,
+                              use_flash=flash_prefill_safe(params)),
+            static_argnums=0, donate_argnums=donate)
         self._prefill_chunk = jax.jit(paged_prefill_chunk, static_argnums=0,
                                       donate_argnums=donate)
         self._decode = jax.jit(

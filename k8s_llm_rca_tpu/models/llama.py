@@ -180,8 +180,11 @@ def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
 def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
                    attention_fn=None):
     """One transformer block over a full sequence.  ``attention_fn``
-    defaults to masked causal attention; the context-parallel prefill
-    passes ring attention instead (same (q, k, v) -> out contract)."""
+    defaults to masked causal attention (always safe: differentiable for
+    training, GSPMD-partitionable for TP); inference prefill passes the
+    Pallas flash kernel via ``prefill_kv(use_flash=True)`` and the
+    context-parallel prefill passes ring attention (same (q, k, v) -> out
+    contract)."""
     h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q, k, v = _qkv(cfg, layer, h, angles, positions)
     if attention_fn is None:
@@ -233,12 +236,19 @@ def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 
 def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
-               length: jnp.ndarray
+               length: jnp.ndarray, use_flash: bool = False
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared prefill compute for both cache designs (contiguous slot write
     below, page scatter in engine/paged.py): run the stack over ONE
     right-padded sequence and return its full-depth KV plus the last valid
     token's logits.
+
+    ``use_flash`` (static) routes attention through the Pallas flash
+    kernel for S_pad >= 1024: the XLA path materializes the [H, S, S]
+    fp32 score matrix and stops compiling around S=8k, flash streams it.
+    Leave False for differentiation (pallas_call has no VJP) or
+    TP-sharded params (no SPMD partitioning rule — it would replicate);
+    the engines enable it automatically when safe.
 
     tokens [1, S_pad], ``length`` scalar valid length.  Returns
     (new_k [L, S_pad, n_kv, d], new_v likewise, logits [1, V]).
@@ -249,9 +259,17 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     seq_lens = jnp.asarray(length).reshape(1)
     x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
 
+    attention_fn = None
+    if use_flash and s_pad >= 1024:
+        from k8s_llm_rca_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, seq_lens,
+                                                       interpret=False)
+
     ks, vs = [], []
     for layer in params["layers"]:
-        x, k, v = _block_prefill(cfg, layer, x, angles, positions, seq_lens)
+        x, k, v = _block_prefill(cfg, layer, x, angles, positions, seq_lens,
+                                 attention_fn)
         ks.append(k[0])  # [S_pad, n_kv, d]
         vs.append(v[0])
 
@@ -261,15 +279,16 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 
 def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
-            tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray
-            ) -> Tuple[KVCache, jnp.ndarray]:
+            tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
+            use_flash: bool = False) -> Tuple[KVCache, jnp.ndarray]:
     """Prefill ONE sequence into cache slot ``slot``.
 
     tokens [1, S_pad] right-padded; ``length`` scalar valid length; returns
     (cache', last-token logits [1, V]).  One compile per padded bucket length
     (engine/engine.py buckets prompt lengths to keep recompiles bounded).
+    ``use_flash``: see prefill_kv.
     """
-    new_k, new_v, logits = prefill_kv(cfg, params, tokens, length)
+    new_k, new_v, logits = prefill_kv(cfg, params, tokens, length, use_flash)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 

@@ -31,10 +31,10 @@ _LANES = 128          # VPU lane width: scratch rows are padded to this
 def _flash_kernel(
     seq_lens_ref,       # SMEM [B]  (valid kv length per batch row)
     q_off_ref,          # SMEM [B]  (absolute position of q block row 0)
-    q_ref,              # VMEM [1, block_q, 1, d]
-    k_ref,              # VMEM [1, block_k, 1, d]
-    v_ref,              # VMEM [1, block_k, 1, d]
-    o_ref,              # VMEM [1, block_q, 1, d]
+    q_ref,              # VMEM [1, 1, block_q, d]   (head-major layout)
+    k_ref,              # VMEM [1, 1, block_k, d]
+    v_ref,              # VMEM [1, 1, block_k, d]
+    o_ref,              # VMEM [1, 1, block_q, d]
     acc_ref,            # VMEM scratch [block_q, d] f32
     m_ref,              # VMEM scratch [block_q, _LANES] f32
     l_ref,              # VMEM scratch [block_q, _LANES] f32
@@ -52,9 +52,9 @@ def _flash_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [bq, d]
-    k = k_ref[0, :, 0, :].astype(jnp.float32)          # [bk, d]
-    v = v_ref[0, :, 0, :].astype(jnp.float32)          # [bk, d]
+    q = q_ref[0, 0].astype(jnp.float32)                # [bq, d]
+    k = k_ref[0, 0].astype(jnp.float32)                # [bk, d]
+    v = v_ref[0, 0].astype(jnp.float32)                # [bk, d]
 
     d = q.shape[-1]
     scale = jax.lax.rsqrt(jnp.float32(d))
@@ -93,7 +93,7 @@ def _flash_kernel(
     def _finalize():
         l = l_ref[:, 0:1]
         safe_l = jnp.where(l == 0.0, 1.0, l)           # padded q rows
-        o_ref[0, :, 0, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
@@ -139,11 +139,15 @@ def flash_attention(
 
     block_q = min(block_q, max(8, s_q))
     block_k = min(block_k, max(8, s_k))
-    qp = _pad_to(q, 1, block_q)
-    kp = _pad_to(k, 1, block_k)
-    vp = _pad_to(v, 1, block_k)
-    n_q_blocks = qp.shape[1] // block_q
-    n_k_blocks = kp.shape[1] // block_k
+    # head-major layout [B, H, S, d]: Mosaic requires the last two block
+    # dims to be (8k, 128k) multiples or the full array dim — (block_q, d)
+    # qualifies (d is the full dim), whereas the natural [B, S, H, d]
+    # blocks (.., block_q, 1, d) do not (the head axis block of 1).
+    qp = _pad_to(q.transpose(0, 2, 1, 3), 2, block_q)   # [B, H, Sq', d]
+    kp = _pad_to(k.transpose(0, 2, 1, 3), 2, block_k)   # [B, Kv, Sk', d]
+    vp = _pad_to(v.transpose(0, 2, 1, 3), 2, block_k)
+    n_q_blocks = qp.shape[2] // block_q
+    n_k_blocks = kp.shape[2] // block_k
 
     grid = (b, n_heads, n_q_blocks, n_k_blocks)
     kernel = functools.partial(_flash_kernel, block_q=block_q,
@@ -155,18 +159,18 @@ def flash_attention(
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, 1, d),
-                         lambda bi, h, qi, ki: (bi, qi, h, 0),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, h, qi, ki: (bi, h, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, h, qi, ki: (bi, ki, h // n_rep, 0),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, h, qi, ki: (bi, h // n_rep, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, h, qi, ki: (bi, ki, h // n_rep, 0),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, h, qi, ki: (bi, h // n_rep, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, d),
-                               lambda bi, h, qi, ki: (bi, qi, h, 0),
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, h, qi, ki: (bi, h, qi, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         scratch_shapes=[
@@ -184,4 +188,4 @@ def flash_attention(
         q_offset.astype(jnp.int32),
         qp, kp, vp,
     )
-    return out[:, :s_q]
+    return out[:, :, :s_q].transpose(0, 2, 1, 3)
