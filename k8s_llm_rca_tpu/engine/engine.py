@@ -281,12 +281,16 @@ class InferenceEngine(EngineBase):
         tokenizer: Tokenizer,
         cp_mesh=None,
         cp_seq_axis: str = "seq",
+        cp_mode: str = "ring",
     ):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
-        then runs context-parallel ring attention over it (long-context
-        mode; the axis size must divide every prefill bucket and
-        max_seq_len, validated below).  Decode is unaffected (its per-step
-        KV is one token)."""
+        then runs context-parallel over it (long-context mode; the axis
+        size must divide every prefill bucket and max_seq_len, validated
+        below).  ``cp_mode``: "ring" (ppermute KV rotation) or "ulysses"
+        (head<->seq all-to-all).  Decode is unaffected (its per-step KV is
+        one token)."""
+        if cp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown cp_mode {cp_mode!r}")
         if cp_mesh is not None:
             n_cp = cp_mesh.shape[cp_seq_axis]
             bad = [s for s in tuple(engine_cfg.prefill_buckets)
@@ -320,7 +324,7 @@ class InferenceEngine(EngineBase):
         if cp_mesh is not None:
             def _prefill_cp(cfg, params, cache, toks, n, slot):
                 return llama.prefill_cp(cfg, params, cache, toks, n, slot,
-                                        cp_mesh, cp_seq_axis)
+                                        cp_mesh, cp_seq_axis, cp_mode)
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:

@@ -26,6 +26,7 @@ on TPU (ops/paged_attention.py) and its XLA reference path elsewhere.
 
 from __future__ import annotations
 
+import functools
 import itertools
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -35,7 +36,7 @@ import numpy as np
 
 from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
 from k8s_llm_rca_tpu.engine.engine import (
-    EngineBase, SequenceResult, _Active, _Pending,
+    EngineBase, SequenceResult, _Active, _Pending, flash_prefill_safe,
 )
 from k8s_llm_rca_tpu.engine.sampling import (
     SamplingParams, sample_tokens, sample_tokens_masked,
@@ -391,10 +392,6 @@ class PagedInferenceEngine(EngineBase):
         # every tick copies the whole pool and peak HBM doubles.  (CPU has
         # no donation support and would warn on every compile, so gate it.)
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
-        import functools
-
-        from k8s_llm_rca_tpu.engine.engine import flash_prefill_safe
-
         self._prefill = jax.jit(
             functools.partial(paged_prefill,
                               use_flash=flash_prefill_safe(params)),

@@ -395,12 +395,17 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
 
 
 def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
-                  length: jnp.ndarray, mesh, seq_axis: str = "seq"
+                  length: jnp.ndarray, mesh, seq_axis: str = "seq",
+                  cp_mode: str = "ring"
                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Context-parallel prefill: ``prefill_kv`` with the sequence sharded
-    over ``mesh[seq_axis]`` and attention computed as ring attention
-    (parallel/ring_attention.py — KV blocks rotate over the ICI ring, the
-    [S, S] score matrix never materializes on one device).
+    over ``mesh[seq_axis]``.
+
+    ``cp_mode``: "ring" — KV blocks rotate over the ICI ring
+    (parallel/ring_attention.py; the [S, S] score matrix never
+    materializes on one device) — or "ulysses" — head<->sequence
+    all-to-all (parallel/ulysses.py; two collectives per attention,
+    better when n_heads >= axis size and S fits one device).
 
     The engine's long-context mode: prompts larger than one device's
     activation budget prefill across the ring; the returned full-depth KV
@@ -414,6 +419,11 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     from jax.sharding import PartitionSpec as P
 
     from k8s_llm_rca_tpu.parallel.ring_attention import ring_attention
+    from k8s_llm_rca_tpu.parallel.ulysses import ulysses_attention
+
+    if cp_mode not in ("ring", "ulysses"):
+        raise ValueError(f"unknown cp_mode {cp_mode!r}")
+    cp_attn = ring_attention if cp_mode == "ring" else ulysses_attention
 
     _, s_pad = tokens.shape
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
@@ -422,11 +432,11 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     x = jax.lax.with_sharding_constraint(
         x, jax.sharding.NamedSharding(mesh, P(None, seq_axis, None)))
 
-    ring = lambda q, k, v: ring_attention(q, k, v, mesh, seq_axis=seq_axis)
+    attn = lambda q, k, v: cp_attn(q, k, v, mesh, seq_axis=seq_axis)
     ks, vs = [], []
     for layer in params["layers"]:
         x, k, v = _block_prefill(cfg, layer, x, angles, positions,
-                                 seq_lens=None, attention_fn=ring)
+                                 seq_lens=None, attention_fn=attn)
         ks.append(k[0])
         vs.append(v[0])
 
@@ -437,9 +447,10 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
                tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
-               mesh, seq_axis: str = "seq") -> Tuple[KVCache, jnp.ndarray]:
+               mesh, seq_axis: str = "seq", cp_mode: str = "ring"
+               ) -> Tuple[KVCache, jnp.ndarray]:
     """Context-parallel variant of ``prefill``: same cache-write contract,
-    ring-attention compute (see prefill_kv_cp)."""
+    ring/Ulysses attention compute (see prefill_kv_cp)."""
     new_k, new_v, logits = prefill_kv_cp(cfg, params, tokens, length, mesh,
-                                         seq_axis)
+                                         seq_axis, cp_mode)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits

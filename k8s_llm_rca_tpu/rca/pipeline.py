@@ -65,6 +65,9 @@ class RCAPipeline:
                 plan = locator.find_destKind_relevantResources(
                     error_message, src_kind, self.prompt_template,
                     self.locator)
+                plan["DestinationKind"]   # missing keys retry with feedback,
+                                          # like the reference's in-try dict
+                                          # access (test_all.py:63-83)
                 return plan, attempt + 1
             except json.JSONDecodeError as e:
                 log.warning("locator JSON error (attempt %d): %s", attempt, e)

@@ -359,3 +359,41 @@ class TestPrefixCaching:
         results = eng.generate(prompts, max_new_tokens=16)
         assert len(results) == 3
         eng.allocator.check()
+
+
+class TestRandomizedChurn:
+    def test_prefix_cache_random_schedule_matches_cache_off(self):
+        """Fuzz: 24 prompts with overlapping prefixes through a small pool
+        (forced evictions + preemptions), cache-on vs cache-off — outputs
+        must be identical and the allocator must end clean."""
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tok = get_tokenizer()
+        rng = np.random.default_rng(7)
+        commons = [tok.encode(f"incident type {i} in namespace prod ",
+                              add_bos=True) for i in range(3)]
+        prompts = []
+        for _ in range(24):
+            base = commons[int(rng.integers(0, 3))]
+            suffix = tok.encode("pod " + "x" * int(rng.integers(1, 12)))
+            prompts.append(base + suffix)
+
+        def run(prefix_cache):
+            ecfg = EngineConfig(max_batch=3, max_seq_len=64, page_size=8,
+                                num_pages=24, prefill_buckets=(16, 32, 64),
+                                max_new_tokens=6, temperature=0.0,
+                                prefix_cache=prefix_cache)
+            eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                       use_kernel=False)
+            out = eng.generate([list(p) for p in prompts], max_new_tokens=6)
+            eng.allocator.check()
+            if eng.prefix_cache is not None:
+                assert (eng.allocator.n_free + eng.prefix_cache.n_resident
+                        == 23)
+                assert (eng.prefix_cache.n_evictable
+                        == eng.prefix_cache.n_resident)
+            else:
+                assert eng.allocator.n_free == 23
+            return [(r.token_ids, r.finish_reason) for r in out]
+
+        assert run(True) == run(False)

@@ -236,3 +236,25 @@ def test_engine_cp_rejects_indivisible_buckets(seq_mesh):
         InferenceEngine(cfg, ecfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
                         get_tokenizer(vocab_size=cfg.vocab_size),
                         cp_mesh=seq_mesh)
+
+
+def test_engine_ulysses_prefill_matches_plain_engine(seq_mesh):
+    """Ulysses is the second engine CP mode: identical greedy output."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    ecfg = EngineConfig(max_batch=1, max_seq_len=64,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompt = tok.encode("image pull backoff registry timeout", add_bos=True)
+
+    ref = InferenceEngine(cfg, ecfg, params, tok).generate(
+        [prompt], max_new_tokens=6)
+    got = InferenceEngine(cfg, ecfg, params, tok, cp_mesh=seq_mesh,
+                          cp_mode="ulysses").generate(
+        [list(prompt)], max_new_tokens=6)
+    assert ref[0].token_ids == got[0].token_ids
