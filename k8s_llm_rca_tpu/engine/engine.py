@@ -344,6 +344,7 @@ class InferenceEngine(EngineBase):
         self._decode_multi = jax.jit(_verify_step, static_argnums=0)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
+        self._decode_scan = jax.jit(decode_scan, static_argnums=(0, 6, 7, 8))
         self._prompts: Dict[int, List[int]] = {}   # seq_id -> prompt (for
         # n-gram draft lookup; dropped at retirement)
 
@@ -370,6 +371,11 @@ class InferenceEngine(EngineBase):
 
         if self._speculation_applies():
             finished.extend(self._speculative_tick())
+            return finished
+
+        chunk = self._scan_chunk()
+        if chunk > 1:
+            finished.extend(self._scan_tick(chunk))
             return finished
 
         active_slots = list(self._active)
@@ -468,6 +474,63 @@ class InferenceEngine(EngineBase):
             prompt_tokens=st.prompt_tokens,
             completion_tokens=len(st.generated),
         )
+
+    # ------------------------------------------------- chunked scan tick
+
+    def _scan_chunk(self) -> int:
+        """Device decode steps to run in ONE dispatch this tick.
+
+        The scan path (decode_scan) amortizes per-dispatch host latency
+        over many steps; it applies only when per-token host work isn't
+        needed: no grammar masks, no queued admissions waiting on a free
+        slot.  The chunk is the largest power of two <= decode_chunk that
+        no slot's token budget cuts short, so budget boundaries still land
+        exactly (stop strings/EOS inside a chunk are trimmed after the
+        fact, same text semantics as the stepwise path)."""
+        limit = self.engine_cfg.decode_chunk
+        if limit <= 1 or self._pending:
+            return 1
+        for st in self._active.values():
+            if st.grammar is not None:
+                return 1
+            limit = min(limit, self._budget_remaining(st))
+        chunk = 1
+        while chunk * 2 <= limit:
+            chunk *= 2
+        return chunk
+
+    def _scan_tick(self, chunk: int) -> List[SequenceResult]:
+        """Commit ``chunk`` decode steps from one on-device scan; token
+        accounting and finish semantics identical to the stepwise tick."""
+        active_slots = list(self._active)
+        self._key, sub = jax.random.split(self._key)
+        with METRICS.timer("engine.decode_step"):
+            self.cache, toks, self.lengths = self._decode_scan(
+                self.model_cfg, self.params, self.cache, self.cur_tokens,
+                self.lengths, sub, chunk, self.sampling,
+                self.tokenizer.eos_id)
+        toks_host = np.asarray(toks)                     # [chunk, B]
+        self.cur_tokens = toks[-1]
+
+        finished: List[SequenceResult] = []
+        for slot in active_slots:
+            st = self._active[slot]
+            base_len = st.prompt_tokens + len(st.generated)
+            committed = 0
+            reason = None
+            for j in range(chunk):
+                token = int(toks_host[j, slot])
+                st.generated.append(token)
+                committed += 1
+                # device length for token j, matching the stepwise tick's
+                # post-increment value: prompt + len(generated) - 1
+                reason = self._finish_reason(st, token, base_len + j)
+                if reason is not None:
+                    break
+            METRICS.inc("engine.decode_tokens", committed)
+            if reason is not None:
+                finished.append(self._retire(slot, reason))
+        return finished
 
     # --------------------------------------------- speculative decoding
 

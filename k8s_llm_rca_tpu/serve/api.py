@@ -363,3 +363,108 @@ class GenericAssistant:
                 for k in usage:
                     usage[k] += run.usage[k]
         return usage
+
+
+# ---------------------------------------------------------------------------
+# persistence (session checkpoint/resume)
+# ---------------------------------------------------------------------------
+
+
+def save_service_state(service: AssistantService, path: str) -> None:
+    """Persist assistants, threads (full message history) and TERMINAL runs
+    to a JSON file.
+
+    The reference kept OpenAI thread/assistant ids in comments so sessions
+    could be resumed by ``retrieve_*`` (reference
+    find_srckind_metapath_neo4j.py:52-53, generate_query.py:25-29, live use
+    bkp_find...:190-192); here the whole store round-trips instead.
+    In-flight runs are not persisted (their engine state is not
+    serializable mid-decode); callers should drain first.
+    """
+    import json
+
+    # peek the id counter without consuming (itertools.count can only be
+    # advanced, so re-seed it with the observed value)
+    next_id = next(service._ids)
+    service._ids = itertools.count(next_id)
+    state = {
+        "next_id": next_id,              # keeps restored ids collision-free
+        "assistants": [
+            {"id": a.id, "name": a.name, "instructions": a.instructions,
+             "model": a.model,
+             "gen": {"max_new_tokens": a.gen.max_new_tokens,
+                     "stop": list(a.gen.stop),
+                     "forced_prefix": a.gen.forced_prefix,
+                     "suffix": a.gen.suffix,
+                     "grammar": a.gen.grammar}}
+            for a in service.assistants.values()
+        ],
+        "threads": [
+            {"id": t.id,
+             "messages": [
+                 {"id": m.id, "role": m.role, "content": m.raw_content,
+                  "created_at": m.created_at}
+                 for m in t.messages
+             ]}
+            for t in service.threads.values()
+        ],
+        "runs": [
+            {"id": r.id, "thread_id": r.thread_id,
+             "assistant_id": r.assistant_id, "status": r.status,
+             "created_at": r.created_at, "completed_at": r.completed_at,
+             "usage": r.usage, "error": r.error,
+             "response_message_id": r.response_message_id}
+            for r in service.runs.values() if r.status in RunStatus.TERMINAL
+        ],
+        "thread_runs": service._thread_runs,
+    }
+    with open(path, "w") as f:
+        json.dump(state, f)
+
+
+def load_service_state(path: str, backend: LMBackend,
+                       run_timeout_s: float = 600.0) -> AssistantService:
+    """Rebuild an AssistantService from ``save_service_state`` output.
+
+    Restored threads keep their ids, so stage code holding thread/assistant
+    ids across a process restart resumes transparently (and
+    ``get_token_usage`` windows over past runs still answer correctly).
+    """
+    import json
+
+    with open(path) as f:
+        state = json.load(f)
+
+    service = AssistantService(backend, run_timeout_s=run_timeout_s)
+    service._ids = itertools.count(state["next_id"])
+    for a in state["assistants"]:
+        g = a.get("gen", {})
+        gen = GenOptions(
+            max_new_tokens=g.get("max_new_tokens", 256),
+            stop=tuple(g.get("stop", ())),
+            forced_prefix=g.get("forced_prefix", ""),
+            suffix=g.get("suffix", ""),
+            grammar=g.get("grammar"))
+        service.assistants[a["id"]] = Assistant(
+            a["id"], a["name"], a["instructions"], a["model"], gen)
+    for t in state["threads"]:
+        thread = Thread(t["id"], [
+            Message(m["id"], m["role"], m["content"], m["created_at"])
+            for m in t["messages"]
+        ])
+        service.threads[thread.id] = thread
+    for r in state["runs"]:
+        run = Run(r["id"], r["thread_id"], r["assistant_id"],
+                  status=r["status"], created_at=r["created_at"],
+                  completed_at=r["completed_at"], usage=r["usage"],
+                  error=r["error"])
+        run.response_message_id = r["response_message_id"]
+        service.runs[run.id] = run
+    terminal = set(service.runs)
+    service._thread_runs = {
+        tid: [rid for rid in rids if rid in terminal]
+        for tid, rids in state["thread_runs"].items()
+    }
+    for tid in service.threads:
+        service._thread_runs.setdefault(tid, [])
+    return service

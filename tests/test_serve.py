@@ -132,3 +132,107 @@ def test_engine_backend_end_to_end():
     assert c2.get_run_status().status == RunStatus.COMPLETED
     u = c1.get_token_usage(0, int(time.time()) + 10)
     assert u["completion_tokens"] > 0
+
+
+def test_service_state_roundtrip(tmp_path, echo_service):
+    """Session checkpoint/resume: the whole assistant/thread/run store
+    round-trips through JSON; resumed threads answer retrieve-by-id and
+    token-usage windows exactly as before the restart."""
+    from k8s_llm_rca_tpu.serve.api import (
+        load_service_state, save_service_state,
+    )
+    from k8s_llm_rca_tpu.serve.backend import EchoBackend
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    service = echo_service
+    a = service.create_assistant("be terse", "helper")
+    t = service.create_thread()
+    service.add_message(t.id, "first question")
+    run = service.create_run(t.id, a.id)
+    service.wait_run(run.id)
+    service.add_message(t.id, "second question")
+    run2 = service.create_run(t.id, a.id)
+    service.wait_run(run2.id)
+
+    path = str(tmp_path / "serve_state.json")
+    save_service_state(service, path)
+    restored = load_service_state(path, EchoBackend(get_tokenizer()))
+
+    rt = restored.retrieve_thread(t.id)
+    assert [m.raw_content for m in rt.messages] == \
+        [m.raw_content for m in service.threads[t.id].messages]
+    assert restored.retrieve_assistant(a.id).instructions == "be terse"
+    # token-usage windows over the restored runs match the live service
+    from k8s_llm_rca_tpu.serve.api import GenericAssistant
+
+    lo = min(r.created_at for r in service.runs.values())
+    hi = max(r.completed_at for r in service.runs.values()) + 1
+
+    def usage_of(svc):
+        ga = GenericAssistant(svc)
+        ga.retrieve_assistant(a.id)
+        ga.retrieve_thread(t.id)
+        return ga.get_token_usage(lo, hi)
+
+    assert usage_of(restored) == usage_of(service)
+    assert usage_of(restored)["total_tokens"] > 0
+    assert [r.id for r in restored.list_runs(t.id)] == \
+        [r.id for r in service.list_runs(t.id)]
+    # the restored service keeps allocating non-colliding ids
+    t2 = restored.create_thread()
+    assert t2.id not in {t.id}
+    # and a new run on the restored thread still works end-to-end
+    restored.add_message(t.id, "third question")
+    r3 = restored.create_run(t.id, a.id)
+    assert restored.wait_run(r3.id).status == "completed"
+
+
+def test_service_state_preserves_gen_options(tmp_path):
+    """Restored assistants must keep their GenOptions — the RCA stage
+    assistants rely on grammar/fence/stop settings for parse guarantees."""
+    from k8s_llm_rca_tpu.serve.api import (
+        AssistantService, load_service_state, save_service_state,
+    )
+    from k8s_llm_rca_tpu.serve.backend import EchoBackend, GenOptions
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    tok = get_tokenizer()
+    service = AssistantService(EchoBackend(tok))
+    gen = GenOptions(max_new_tokens=512, stop=("```",),
+                     forced_prefix="```json\n", suffix="\n```",
+                     grammar="json")
+    a = service.create_assistant("plan", "locator", gen=gen)
+    path = str(tmp_path / "state.json")
+    save_service_state(service, path)
+    # saving must not mutate the live service (snapshot idempotence)
+    save_service_state(service, path)
+    restored = load_service_state(path, EchoBackend(tok))
+    got = restored.retrieve_assistant(a.id).gen
+    assert got == gen
+
+
+def test_scan_tick_matches_stepwise_near_cache_cap():
+    """decode_chunk must not change WHERE a cache-capacity 'length' fires
+    (regression: the scan tick once passed an off-by-one device length)."""
+    import jax
+
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompt = list(range(5, 25))           # 20 tokens; cap at 32
+
+    def run(chunk):
+        eng = InferenceEngine(
+            cfg, EngineConfig(max_batch=1, max_seq_len=32,
+                              prefill_buckets=(32,), max_new_tokens=30,
+                              temperature=0.0, decode_chunk=chunk),
+            params, tok)
+        r = eng.generate([list(prompt)], max_new_tokens=30)[0]
+        return r.token_ids, r.finish_reason, r.completion_tokens
+
+    assert run(1) == run(8)
