@@ -63,12 +63,29 @@ def _dense(key, shape, scale, dtype):
     return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
 
 
-def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
-    """Random init (scaled normal).  Real checkpoints load via models/loader."""
+def init_params(cfg: ModelConfig, key: jax.Array,
+                tensor_transform=None) -> Params:
+    """Random init (scaled normal).  Real checkpoints load via models/loader.
+
+    ``tensor_transform``: optional hook applied to every matmul weight AS
+    IT IS CREATED (norm gains excluded).  Streaming quantization goes
+    through this — e.g. ``models.quant.quantize`` per tensor keeps peak
+    HBM near the int8 size instead of bf16 + int8 resident together,
+    which is what lets an 8B model initialize quantized on a 16G chip.
+    """
     dtype = jnp.dtype(cfg.dtype)
     h, q, kv, inter = cfg.hidden_size, cfg.q_dim, cfg.kv_dim, cfg.intermediate_size
     keys = jax.random.split(key, cfg.n_layers + 2)
     scale = 1.0 / math.sqrt(h)
+
+    tt = tensor_transform or (lambda w, **_: w)
+
+    def _tdense(key, shape, scale, **tt_kw):
+        w = _dense(key, shape, scale, dtype)
+        out = tt(w, **tt_kw)
+        if out is not w:
+            w.delete()                   # free the full-precision original
+        return out
 
     layers = []
     for i in range(cfg.n_layers):
@@ -76,42 +93,45 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         layer: Dict[str, Any] = {
             "attn_norm": jnp.ones((h,), dtype),
             "mlp_norm": jnp.ones((h,), dtype),
-            "wq": _dense(lk[0], (h, q), scale, dtype),
-            "wk": _dense(lk[1], (h, kv), scale, dtype),
-            "wv": _dense(lk[2], (h, kv), scale, dtype),
-            "wo": _dense(lk[3], (q, h), scale / math.sqrt(2 * cfg.n_layers), dtype),
+            "wq": _tdense(lk[0], (h, q), scale),
+            "wk": _tdense(lk[1], (h, kv), scale),
+            "wv": _tdense(lk[2], (h, kv), scale),
+            "wo": _tdense(lk[3], (q, h), scale / math.sqrt(2 * cfg.n_layers)),
         }
         if cfg.n_experts > 0:
             e = cfg.n_experts
             layer.update(
                 {
-                    "router": _dense(lk[4], (h, e), scale, dtype),
-                    "w_gate": _dense(lk[5], (e, h, inter), scale, dtype),
-                    "w_up": _dense(lk[6], (e, h, inter), scale, dtype),
-                    "w_down": _dense(
-                        lk[7], (e, inter, h), scale / math.sqrt(2 * cfg.n_layers), dtype
-                    ),
+                    "router": _tdense(lk[4], (h, e), scale),
+                    "w_gate": _tdense(lk[5], (e, h, inter), scale,
+                                      axis=(0, -1)),
+                    "w_up": _tdense(lk[6], (e, h, inter), scale,
+                                    axis=(0, -1)),
+                    "w_down": _tdense(
+                        lk[7], (e, inter, h),
+                        scale / math.sqrt(2 * cfg.n_layers), axis=(0, -1)),
                 }
             )
         else:
             layer.update(
                 {
-                    "w_gate": _dense(lk[5], (h, inter), scale, dtype),
-                    "w_up": _dense(lk[6], (h, inter), scale, dtype),
-                    "w_down": _dense(
-                        lk[7], (inter, h), scale / math.sqrt(2 * cfg.n_layers), dtype
-                    ),
+                    "w_gate": _tdense(lk[5], (h, inter), scale),
+                    "w_up": _tdense(lk[6], (h, inter), scale),
+                    "w_down": _tdense(
+                        lk[7], (inter, h),
+                        scale / math.sqrt(2 * cfg.n_layers)),
                 }
             )
         layers.append(layer)
 
     params: Params = {
-        "embedding": _dense(keys[-2], (cfg.vocab_size, h), 1.0, dtype),
+        "embedding": _tdense(keys[-2], (cfg.vocab_size, h), 1.0, axis=0),
         "final_norm": jnp.ones((h,), dtype),
         "layers": layers,
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = _dense(keys[-1], (cfg.vocab_size, h), scale, dtype)
+        params["lm_head"] = _tdense(keys[-1], (cfg.vocab_size, h), scale,
+                                    axis=0)
     return params
 
 

@@ -112,3 +112,14 @@ def quantize_params(params: Any, compute_dtype=jnp.bfloat16) -> Any:
     return jax.tree_util.tree_map_with_path(
         _quantize_entry, params,
         is_leaf=lambda x: isinstance(x, QuantTensor))
+
+
+def quantizing_transform(compute_dtype=jnp.bfloat16):
+    """tensor_transform for ``llama.init_params``: quantize every matmul
+    weight as it is created, so peak HBM tracks the int8 model size.
+    The ``axis`` hint from init_params selects per-row (embedding/head),
+    per-(expert, column) (stacked experts) or per-column scales."""
+    def transform(w, axis=-1):
+        return quantize(w, axis=axis, compute_dtype=compute_dtype)
+
+    return transform
