@@ -124,8 +124,10 @@ def bench_8b():
                               eos_id=-1)
 
 
-def bench_rca_p50():
-    """Hermetic 4-incident RCA sweep p50 latency (oracle backend)."""
+def bench_rca_p50(n_incidents: int = 100):
+    """Hermetic 100-incident RCA sweep p50 latency (oracle backend) — the
+    BASELINE north-star workload shape (configs[2]), cycling the canned
+    incident corpus."""
     from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
     from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS, build_metagraph, \
         build_stategraph
@@ -139,7 +141,8 @@ def bench_rca_p50():
         InMemoryGraphExecutor(build_stategraph()),
         RCAConfig())
     costs = sorted(
-        pipeline.analyze_incident(i.message)["time_cost"] for i in INCIDENTS)
+        pipeline.analyze_incident(INCIDENTS[i % len(INCIDENTS)].message)
+        ["time_cost"] for i in range(n_incidents))
     return costs[len(costs) // 2]
 
 

@@ -164,3 +164,21 @@ def test_max_new_exceeding_cache_is_clamped(setup):
     # reserved generation room: cap//4 = 32 tokens of prompt budget headroom
     assert res.prompt_tokens <= 128 - 32 - 1
     assert res.completion_tokens >= 32
+
+
+def test_engine_runs_moe_model():
+    """Continuous batching over a Mixtral-style MoE model (dense
+    soft-dispatch MLP in decode): greedy generate works end-to-end."""
+    from k8s_llm_rca_tpu.config import TINY_MOE, EngineConfig
+
+    cfg = TINY_MOE.replace(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    eng = InferenceEngine(
+        cfg, EngineConfig(max_batch=2, max_seq_len=64,
+                          prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                          temperature=0.0), params, tok)
+    res = eng.generate([tok.encode("pod oom", add_bos=True),
+                        tok.encode("pvc pending", add_bos=True)],
+                       max_new_tokens=6)
+    assert all(r.completion_tokens == 6 for r in res)

@@ -258,3 +258,31 @@ def test_engine_ulysses_prefill_matches_plain_engine(seq_mesh):
                           cp_mode="ulysses").generate(
         [list(prompt)], max_new_tokens=6)
     assert ref[0].token_ids == got[0].token_ids
+
+
+def test_ep_sharded_engine_matches_unsharded(cpu_devices):
+    """EP serving: MoE engine fed expert-sharded params must emit the same
+    greedy tokens as the unsharded engine (GSPMD partitions the dense
+    soft-dispatch einsums over the expert axis)."""
+    from k8s_llm_rca_tpu.config import EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY_MOE.replace(n_experts=4, max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, expert=4), devices=cpu_devices[:8])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("node notready kubelet down", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok).generate(
+        prompts, max_new_tokens=6)
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    got = make_engine(cfg, ecfg, sharded, tok).generate(
+        [list(prompts[0])], max_new_tokens=6)
+    assert ref[0].token_ids == got[0].token_ids
