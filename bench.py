@@ -42,13 +42,13 @@ def pick_config():
     dev = jax.devices()[0]
     if dev.platform != "tpu":
         return TINY.replace(name="bench-tiny"), 8, 64, 128, False
-    # one chip (~16G HBM): TinyLlama-1.1B int8 ~1.1G weights; the merged-dim
-    # KV cache ([..., n_kv*d], models/llama.KVCache) holds batch=256 at
-    # seq 1280 in ~7.4G, and decode is latency-bound on this chip, so
-    # throughput scales ~linearly with batch up to the HBM ceiling.
-    # max_seq must hold prompt + warmup scan + measured scan (128 + 2*512).
+    # one chip (~16G HBM): TinyLlama-1.1B int8 ~1.1G weights; with the
+    # merged-dim per-token-quantized int8 KV cache (models/llama.KVCache)
+    # batch=384 at seq 1280 fits in ~5.6G, and decode is latency-bound on
+    # this chip, so throughput scales ~linearly with batch up to the HBM
+    # ceiling.  max_seq holds prompt + warmup scan + measured scan.
     cfg = MODEL_REGISTRY["tinyllama-1.1b"].replace(max_seq_len=1280)
-    return cfg, 256, 128, 512, True
+    return cfg, 384, 128, 512, True
 
 
 def _timed_decode_scan(cfg, params, cache, batch, prompt_len, decode_steps,
@@ -79,7 +79,8 @@ def bench_decode(cfg, batch, prompt_len, decode_steps, quantize=False):
     if quantize:
         from k8s_llm_rca_tpu.models.quant import quantize_params
         params = quantize_params(params)
-    cache = llama.init_cache(cfg, batch, cfg.max_seq_len)
+    cache = llama.init_cache(cfg, batch, cfg.max_seq_len,
+                             kv_dtype=jnp.int8 if quantize else None)
     tok = get_tokenizer(vocab_size=cfg.vocab_size)
 
     rng = np.random.default_rng(0)
@@ -111,15 +112,16 @@ def bench_decode(cfg, batch, prompt_len, decode_steps, quantize=False):
 def bench_8b():
     """Llama-3-8B int8 decode throughput on one chip (the BASELINE metric
     names tokens/sec/chip at ~7-8B scale).  Streaming quantized init keeps
-    peak HBM near the int8 model size (~8G), leaving room for a batch-24
-    KV cache on a 16G chip."""
+    peak HBM near the int8 model size (~8G); the int8 KV cache fits a
+    batch-64 cache in the remaining HBM of a 16G chip."""
     from k8s_llm_rca_tpu.models.quant import quantizing_transform
 
     cfg = MODEL_REGISTRY["llama3-8b"].replace(max_seq_len=768)
     params = llama.init_params(cfg, jax.random.PRNGKey(0),
                                tensor_transform=quantizing_transform())
-    batch, prompt_len, steps = 24, 128, 256
-    cache = llama.init_cache(cfg, batch, cfg.max_seq_len)
+    batch, prompt_len, steps = 64, 128, 256
+    cache = llama.init_cache(cfg, batch, cfg.max_seq_len,
+                             kv_dtype=jnp.int8)
     return _timed_decode_scan(cfg, params, cache, batch, prompt_len, steps,
                               eos_id=-1)
 
@@ -167,6 +169,7 @@ def main():
         "vs_baseline": round(decode_tps / REFERENCE_TOKENS_PER_S, 2),
         "model": cfg.name,
         "weights": "int8" if quantize else "bf16",
+        "kv_cache": "int8" if quantize else "bf16",
         "batch": batch,
         "prefill_tokens_per_s": round(prefill_tps, 2),
         "tokens_per_s_8b_int8": tps_8b,

@@ -179,6 +179,9 @@ class EngineConfig:
     max_seq_len: int = 1024            # per-slot KV capacity
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024)
     max_new_tokens: int = 256
+    # contiguous-cache KV storage: None = model dtype; "int8" = per-token
+    # quantized KV (half the cache HBM/bandwidth, small quality cost)
+    kv_cache_dtype: Optional[str] = None
     # paged KV cache
     paged: bool = False
     page_size: int = 16

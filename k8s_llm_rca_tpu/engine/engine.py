@@ -311,7 +311,14 @@ class InferenceEngine(EngineBase):
         )
 
         b = engine_cfg.max_batch
-        self.cache = llama.init_cache(model_cfg, b, engine_cfg.max_seq_len)
+        if engine_cfg.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"unsupported kv_cache_dtype {engine_cfg.kv_cache_dtype!r} "
+                f"(None or 'int8')")
+        self.cache = llama.init_cache(
+            model_cfg, b, engine_cfg.max_seq_len,
+            kv_dtype=jnp.int8 if engine_cfg.kv_cache_dtype == "int8"
+            else None)
         self.lengths = jnp.zeros((b,), jnp.int32)
         self.cur_tokens = jnp.zeros((b,), jnp.int32)
         self._key = jax.random.PRNGKey(engine_cfg.seed)

@@ -45,10 +45,19 @@ class KVCache(NamedTuple):
     attention read bandwidth.  [..., n_kv*64] keeps the lane axis a
     multiple of 128; call sites reshape to per-head form next to the
     attention einsum, where XLA fuses the (free, row-major) split.
+
+    Optional int8 mode (``init_cache(kv_dtype=jnp.int8)``): k/v are int8
+    with one dynamic scale per written token (``k_scale``/``v_scale``
+    [L, B, S_max], amax/127 over that token's merged kv vector) — halves
+    cache HBM and attention read bandwidth at a small quantization cost.
+    Scales are per-token scalars, not per-head, because a [..., S, n_kv]
+    scale array would pad n_kv=4 -> 128 lanes and eat the savings.
     """
 
     k: jnp.ndarray
     v: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
     @property
     def max_seq_len(self) -> int:
@@ -57,6 +66,10 @@ class KVCache(NamedTuple):
     @property
     def n_slots(self) -> int:
         return self.k.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def _dense(key, shape, scale, dtype):
@@ -135,7 +148,9 @@ def init_params(cfg: ModelConfig, key: jax.Array,
     return params
 
 
-def init_cache(cfg: ModelConfig, n_slots: int, max_seq_len: Optional[int] = None) -> KVCache:
+def init_cache(cfg: ModelConfig, n_slots: int,
+               max_seq_len: Optional[int] = None,
+               kv_dtype: Optional[Any] = None) -> KVCache:
     s = max_seq_len or cfg.max_seq_len
     if s > cfg.max_seq_len:
         # positions past the RoPE table would silently clamp to its last row
@@ -143,7 +158,14 @@ def init_cache(cfg: ModelConfig, n_slots: int, max_seq_len: Optional[int] = None
         raise ValueError(
             f"cache max_seq_len {s} exceeds model max_seq_len {cfg.max_seq_len}")
     shape = (cfg.n_layers, n_slots, s, cfg.kv_dim)
-    dtype = jnp.dtype(cfg.dtype)
+    if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
+        # two DISTINCT buffers: aliasing one zeros array as both scales
+        # would donate the same buffer twice under donate_argnums
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       k_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)),
+                       v_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)))
+    dtype = jnp.dtype(kv_dtype or cfg.dtype)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -218,16 +240,44 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
     return x, k, v
 
 
+def _quantize_kv(kv: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token int8: kv [..., kv_dim] -> (int8 same shape, scale [...])."""
+    amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(kv.astype(jnp.float32) / scale[..., None]),
+                 -127, 127)
+    return q.astype(jnp.int8), scale.astype(kv.dtype)
+
+
+def _dequant_layer(k_cache: jnp.ndarray, scale: Optional[jnp.ndarray],
+                   dtype) -> jnp.ndarray:
+    """[B, S, kv_dim] int8 + [B, S] scale -> dtype (identity when scale is
+    None).  Expressed as convert*scale at the read site for XLA to fuse
+    into the attention einsum."""
+    if scale is None:
+        return k_cache
+    return k_cache.astype(dtype) * scale[..., None].astype(dtype)
+
+
 def _write_prefill_kv(cfg: ModelConfig, cache: KVCache, new_k, new_v,
                       slot) -> KVCache:
     """Write one sequence's full-depth prefill KV into cache slot ``slot``
     at sequence offset 0 (shared by the plain and CP prefill paths)."""
     L, s_pad = new_k.shape[0], new_k.shape[1]
-    k_cache = jax.lax.dynamic_update_slice(
-        cache.k, new_k.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        cache.v, new_v.reshape(L, 1, s_pad, cfg.kv_dim), (0, slot, 0, 0))
-    return KVCache(k_cache, v_cache)
+    new_k = new_k.reshape(L, 1, s_pad, cfg.kv_dim)
+    new_v = new_v.reshape(L, 1, s_pad, cfg.kv_dim)
+    if cache.quantized:
+        new_k, ks = _quantize_kv(new_k)
+        new_v, vs = _quantize_kv(new_v)
+        k_scale = jax.lax.dynamic_update_slice(cache.k_scale, ks,
+                                               (0, slot, 0))
+        v_scale = jax.lax.dynamic_update_slice(cache.v_scale, vs,
+                                               (0, slot, 0))
+    else:
+        k_scale, v_scale = cache.k_scale, cache.v_scale
+    k_cache = jax.lax.dynamic_update_slice(cache.k, new_k, (0, slot, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(cache.v, new_v, (0, slot, 0, 0))
+    return KVCache(k_cache, v_cache, k_scale, v_scale)
 
 
 def _logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -322,6 +372,35 @@ def _write_token_kv(cache_layer: jnp.ndarray, kv_new: jnp.ndarray,
     return jax.vmap(write_one)(cache_layer, kv_new, lengths)
 
 
+def _write_token_scale(scale_layer: jnp.ndarray, s_new: jnp.ndarray,
+                       lengths: jnp.ndarray) -> jnp.ndarray:
+    """Scatter one token's quant scale per slot: scales [B, S], s_new [B]."""
+    def write_one(sl, s, pos):
+        return jax.lax.dynamic_update_slice(sl, s[None], (pos,))
+
+    return jax.vmap(write_one)(scale_layer, s_new, lengths)
+
+
+def _store_layer_kv(cache: KVCache, li: int, k_new: jnp.ndarray,
+                    v_new: jnp.ndarray, lengths: jnp.ndarray):
+    """Write one layer's new-token k/v ([B, kv_dim] or [B, T, kv_dim])
+    into the cache at per-slot offsets, quantizing when the cache is int8.
+    Returns (k_layer, v_layer, k_scale_layer, v_scale_layer) — the scale
+    layers are None for full-precision caches."""
+    multi = k_new.ndim == 3
+    write_kv = _write_tokens_kv if multi else _write_token_kv
+    write_s = _write_tokens_scale if multi else _write_token_scale
+    if cache.quantized:
+        k_q, k_s = _quantize_kv(k_new)
+        v_q, v_s = _quantize_kv(v_new)
+        return (write_kv(cache.k[li], k_q, lengths),
+                write_kv(cache.v[li], v_q, lengths),
+                write_s(cache.k_scale[li], k_s, lengths),
+                write_s(cache.v_scale[li], v_s, lengths))
+    return (write_kv(cache.k[li], k_new, lengths),
+            write_kv(cache.v[li], v_new, lengths), None, None)
+
+
 def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
                 tokens: jnp.ndarray, lengths: jnp.ndarray
                 ) -> Tuple[KVCache, jnp.ndarray]:
@@ -337,25 +416,33 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
     x = gather_rows(params["embedding"], tokens[:, None]).astype(jnp.dtype(cfg.dtype))
 
     s_max = cache.max_seq_len
-    new_ks, new_vs = [], []
+    dtype = jnp.dtype(cfg.dtype)
+    new_ks, new_vs, new_kss, new_vss = [], [], [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(cfg, layer, h, angles, positions)   # q [B,1,h,d]
-        k_cache = _write_token_kv(cache.k[li], k[:, 0].reshape(b, cfg.kv_dim),
-                                  lengths)
-        v_cache = _write_token_kv(cache.v[li], v[:, 0].reshape(b, cfg.kv_dim),
-                                  lengths)
+        k_cache, v_cache, k_s, v_s = _store_layer_kv(
+            cache, li, k[:, 0].reshape(b, cfg.kv_dim),
+            v[:, 0].reshape(b, cfg.kv_dim), lengths)
         new_ks.append(k_cache)
         new_vs.append(v_cache)
+        new_kss.append(k_s)
+        new_vss.append(v_s)
         attn = decode_attention(
-            q, k_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
-            v_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            q,
+            _dequant_layer(k_cache, k_s, dtype).reshape(
+                b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            _dequant_layer(v_cache, v_s, dtype).reshape(
+                b, s_max, cfg.n_kv_heads, cfg.head_dim),
             lengths + 1)
         x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, layer, hm)
 
-    cache = KVCache(jnp.stack(new_ks), jnp.stack(new_vs))
+    cache = KVCache(
+        jnp.stack(new_ks), jnp.stack(new_vs),
+        jnp.stack(new_kss) if cache.quantized else None,
+        jnp.stack(new_vss) if cache.quantized else None)
     logits = _logits(cfg, params, x)[:, 0]             # [B, V]
     return cache, logits
 
@@ -368,6 +455,15 @@ def _write_tokens_kv(cache_layer: jnp.ndarray, kv_new: jnp.ndarray,
         return jax.lax.dynamic_update_slice(c, kv, (pos, 0))
 
     return jax.vmap(write_one)(cache_layer, kv_new, lengths)
+
+
+def _write_tokens_scale(scale_layer: jnp.ndarray, s_new: jnp.ndarray,
+                        lengths: jnp.ndarray) -> jnp.ndarray:
+    """Scatter T tokens' quant scales per slot: scales [B, S], s_new [B, T]."""
+    def write_one(sl, s, pos):
+        return jax.lax.dynamic_update_slice(sl, s, (pos,))
+
+    return jax.vmap(write_one)(scale_layer, s_new, lengths)
 
 
 def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
@@ -391,25 +487,33 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
     positions = lengths[:, None] + jnp.arange(t)[None, :]       # [B, T]
     x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
 
-    new_ks, new_vs = [], []
+    dtype = jnp.dtype(cfg.dtype)
+    new_ks, new_vs, new_kss, new_vss = [], [], [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(cfg, layer, h, angles, positions)        # [B,T,·,d]
-        k_cache = _write_tokens_kv(cache.k[li],
-                                   k.reshape(b, t, cfg.kv_dim), lengths)
-        v_cache = _write_tokens_kv(cache.v[li],
-                                   v.reshape(b, t, cfg.kv_dim), lengths)
+        k_cache, v_cache, k_s, v_s = _store_layer_kv(
+            cache, li, k.reshape(b, t, cfg.kv_dim),
+            v.reshape(b, t, cfg.kv_dim), lengths)
         new_ks.append(k_cache)
         new_vs.append(v_cache)
+        new_kss.append(k_s)
+        new_vss.append(v_s)
         attn = decode_attention_multi(
-            q, k_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
-            v_cache.reshape(b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            q,
+            _dequant_layer(k_cache, k_s, dtype).reshape(
+                b, s_max, cfg.n_kv_heads, cfg.head_dim),
+            _dequant_layer(v_cache, v_s, dtype).reshape(
+                b, s_max, cfg.n_kv_heads, cfg.head_dim),
             lengths + 1)
         x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, layer, hm)
 
-    cache = KVCache(jnp.stack(new_ks), jnp.stack(new_vs))
+    cache = KVCache(
+        jnp.stack(new_ks), jnp.stack(new_vs),
+        jnp.stack(new_kss) if cache.quantized else None,
+        jnp.stack(new_vss) if cache.quantized else None)
     logits = _logits(cfg, params, x)                            # [B, T, V]
     return cache, logits
 

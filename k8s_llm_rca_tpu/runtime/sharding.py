@@ -104,8 +104,12 @@ def kv_cache_specs() -> Any:
 
 
 def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
-    """Device-put a pytree with NamedShardings built from a spec pytree."""
+    """Device-put a pytree with NamedShardings built from a spec pytree.
+    ``None`` leaves (optional fields, e.g. KVCache scale arrays of a
+    full-precision cache) pass through unsharded."""
     def _put(x, spec):
+        if x is None:
+            return None
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(_put, tree, specs, is_leaf=lambda x: x is None)
