@@ -210,6 +210,63 @@ class EngineBase:
     def step(self) -> List[SequenceResult]:
         raise NotImplementedError
 
+    # ---------------------------------------- chunked scan tick (shared)
+
+    def _chunk_bound(self, slot: int) -> int:
+        """Subclass hook: extra per-slot cap on the scan chunk (the paged
+        engine bounds by distance to the slot's next page boundary)."""
+        return self.engine_cfg.decode_chunk
+
+    def _scan_chunk(self) -> int:
+        """Device decode steps to run in ONE dispatch this tick.
+
+        The scan path amortizes per-dispatch host latency over many steps;
+        it applies only when per-token host work isn't needed: no grammar
+        masks, no queued admissions waiting on a free slot.  The chunk is
+        the largest power of two <= decode_chunk that no slot's token
+        budget (or subclass bound) cuts short, so budget boundaries still
+        land exactly (stop strings/EOS inside a chunk are trimmed after
+        the fact, same text semantics as the stepwise path)."""
+        limit = self.engine_cfg.decode_chunk
+        if limit <= 1 or self._pending:
+            return 1
+        for slot, st in self._active.items():
+            if st.grammar is not None:
+                return 1
+            limit = min(limit, self._budget_remaining(st),
+                        self._chunk_bound(slot))
+        chunk = 1
+        while chunk * 2 <= limit:
+            chunk *= 2
+        return chunk
+
+    def _commit_scanned(self, active_slots, toks_host, chunk: int,
+                        post_commit=None) -> List[SequenceResult]:
+        """Shared commit loop for scanned tokens: append, per-token finish
+        check at the stepwise-equivalent device length (prompt +
+        len(generated) - 1), metrics, mid-chunk retirement.  ``post_commit``
+        lets a subclass update its host-side length/token arrays per
+        commit."""
+        finished: List[SequenceResult] = []
+        for slot in active_slots:
+            st = self._active[slot]
+            base_len = st.prompt_tokens + len(st.generated)
+            committed = 0
+            reason = None
+            for j in range(chunk):
+                token = int(toks_host[j, slot])
+                st.generated.append(token)
+                committed += 1
+                if post_commit is not None:
+                    post_commit(slot, token)
+                reason = self._finish_reason(st, token, base_len + j)
+                if reason is not None:
+                    break
+            METRICS.inc("engine.decode_tokens", committed)
+            if reason is not None:
+                finished.append(self._retire(slot, reason))
+        return finished
+
     def run_to_completion(self) -> List[SequenceResult]:
         """Pump until queue and slots drain; returns all finished sequences."""
         out: List[SequenceResult] = []
@@ -484,28 +541,6 @@ class InferenceEngine(EngineBase):
 
     # ------------------------------------------------- chunked scan tick
 
-    def _scan_chunk(self) -> int:
-        """Device decode steps to run in ONE dispatch this tick.
-
-        The scan path (decode_scan) amortizes per-dispatch host latency
-        over many steps; it applies only when per-token host work isn't
-        needed: no grammar masks, no queued admissions waiting on a free
-        slot.  The chunk is the largest power of two <= decode_chunk that
-        no slot's token budget cuts short, so budget boundaries still land
-        exactly (stop strings/EOS inside a chunk are trimmed after the
-        fact, same text semantics as the stepwise path)."""
-        limit = self.engine_cfg.decode_chunk
-        if limit <= 1 or self._pending:
-            return 1
-        for st in self._active.values():
-            if st.grammar is not None:
-                return 1
-            limit = min(limit, self._budget_remaining(st))
-        chunk = 1
-        while chunk * 2 <= limit:
-            chunk *= 2
-        return chunk
-
     def _scan_tick(self, chunk: int) -> List[SequenceResult]:
         """Commit ``chunk`` decode steps from one on-device scan; token
         accounting and finish semantics identical to the stepwise tick."""
@@ -518,26 +553,7 @@ class InferenceEngine(EngineBase):
                 self.tokenizer.eos_id)
         toks_host = np.asarray(toks)                     # [chunk, B]
         self.cur_tokens = toks[-1]
-
-        finished: List[SequenceResult] = []
-        for slot in active_slots:
-            st = self._active[slot]
-            base_len = st.prompt_tokens + len(st.generated)
-            committed = 0
-            reason = None
-            for j in range(chunk):
-                token = int(toks_host[j, slot])
-                st.generated.append(token)
-                committed += 1
-                # device length for token j, matching the stepwise tick's
-                # post-increment value: prompt + len(generated) - 1
-                reason = self._finish_reason(st, token, base_len + j)
-                if reason is not None:
-                    break
-            METRICS.inc("engine.decode_tokens", committed)
-            if reason is not None:
-                finished.append(self._retire(slot, reason))
-        return finished
+        return self._commit_scanned(active_slots, toks_host, chunk)
 
     # --------------------------------------------- speculative decoding
 

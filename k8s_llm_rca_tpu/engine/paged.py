@@ -318,6 +318,39 @@ def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
     return k_pages, v_pages, logits
 
 
+def paged_decode_scan(cfg: ModelConfig, params, k_pages, v_pages,
+                      cur_tokens: jnp.ndarray, lengths: jnp.ndarray,
+                      block_tables: jnp.ndarray, key, n_steps: int,
+                      sampling: SamplingParams, eos_id: int,
+                      use_kernel: Optional[bool] = None):
+    """``n_steps`` paged decode steps with zero host sync (the paged
+    engine's chunked tick).  Valid only while no sequence crosses a page
+    boundary — the caller bounds ``n_steps`` by each slot's distance to
+    its next boundary so ``block_tables`` stays static for the whole scan.
+
+    Returns (k_pages', v_pages', tokens [n_steps, B], lengths').  Slots
+    that hit ``eos_id`` stop advancing (token repeats; host trims)."""
+
+    def body(carry, _):
+        kp, vp, cur, lens, done, key = carry
+        kp, vp, logits = paged_decode_step(cfg, params, kp, vp, cur, lens,
+                                           block_tables,
+                                           use_kernel=use_kernel)
+        key, sub = jax.random.split(key)
+        nxt = sample_tokens(logits, sub, sampling)
+        newly_done = done | (nxt == eos_id)
+        advance = jnp.logical_not(done)
+        cur = jnp.where(advance, nxt, cur)
+        lens = lens + advance.astype(lens.dtype)
+        return (kp, vp, cur, lens, newly_done, key), cur
+
+    done0 = jnp.zeros_like(cur_tokens, dtype=bool)
+    (k_pages, v_pages, _, lengths, _, _), toks = jax.lax.scan(
+        body, (k_pages, v_pages, cur_tokens, lengths, done0, key), None,
+        length=n_steps)
+    return k_pages, v_pages, toks, lengths
+
+
 # ---------------------------------------------------------------------------
 # engine
 # ---------------------------------------------------------------------------
@@ -401,6 +434,9 @@ class PagedInferenceEngine(EngineBase):
         self._decode = jax.jit(
             paged_decode_step, static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
+        self._decode_scan = jax.jit(
+            paged_decode_scan, static_argnums=(0, 8, 9, 10),
+            donate_argnums=donate, static_argnames=("use_kernel",))
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
 
@@ -457,6 +493,11 @@ class PagedInferenceEngine(EngineBase):
         if not active_slots:
             return finished
 
+        chunk = self._scan_chunk()
+        if chunk > 1:
+            finished.extend(self._scan_tick(chunk, active_slots))
+            return finished
+
         forced, allow = self._tick_constraints(
             active_slots, self.engine_cfg.max_batch,
             self.model_cfg.vocab_size)
@@ -488,6 +529,35 @@ class PagedInferenceEngine(EngineBase):
             if reason is not None:
                 finished.append(self._retire(slot, reason))
         return finished
+
+    # ------------------------------------------------- chunked scan tick
+
+    def _chunk_bound(self, slot: int) -> int:
+        # paged-only bound: no slot may cross a page boundary mid-scan
+        # (the block tables must stay static for the whole scan); growth
+        # already ran this tick, so the current page has
+        # page_size - (lengths % page_size) free positions
+        return self.page_size - int(self.lengths[slot]) % self.page_size
+
+    def _scan_tick(self, chunk: int, active_slots) -> List[SequenceResult]:
+        """Commit ``chunk`` paged decode steps from one on-device scan;
+        accounting identical to the stepwise tick (shared commit loop)."""
+        self._key, sub = jax.random.split(self._key)
+        with METRICS.timer("engine.decode_step"):
+            self.k_pages, self.v_pages, toks, _ = self._decode_scan(
+                self.model_cfg, self.params, self.k_pages, self.v_pages,
+                jnp.asarray(self.cur_tokens, jnp.int32),
+                jnp.asarray(self.lengths, jnp.int32),
+                jnp.asarray(self.block_tables), sub, chunk, self.sampling,
+                self.tokenizer.eos_id, use_kernel=self.use_kernel)
+        toks_host = np.asarray(toks)                    # [chunk, B]
+
+        def post_commit(slot: int, token: int) -> None:
+            self.lengths[slot] += 1
+            self.cur_tokens[slot] = token
+
+        return self._commit_scanned(active_slots, toks_host, chunk,
+                                    post_commit)
 
     # ------------------------------------------------------------- internals
 

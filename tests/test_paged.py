@@ -397,3 +397,29 @@ class TestRandomizedChurn:
             return [(r.token_ids, r.finish_reason) for r in out]
 
         assert run(True) == run(False)
+
+
+class TestPagedScanTick:
+    def test_chunk_on_off_identical_across_boundaries(self):
+        """Paged scan ticks must produce identical greedy output to the
+        stepwise path, including around page boundaries and eos."""
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tok = get_tokenizer()
+        prompts = [tok.encode("pod crashloop backoff", add_bos=True),
+                   tok.encode("pvc stuck pending", add_bos=True)]
+
+        def run(chunk):
+            ecfg = EngineConfig(max_batch=2, max_seq_len=64, page_size=8,
+                                num_pages=64, prefill_buckets=(16, 32, 64),
+                                max_new_tokens=20, temperature=0.0,
+                                decode_chunk=chunk, prefix_cache=False)
+            eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                       use_kernel=False)
+            out = eng.generate([list(p) for p in prompts],
+                               max_new_tokens=20)
+            eng.allocator.check()
+            assert eng.allocator.n_free == 63
+            return [(r.token_ids, r.finish_reason) for r in out]
+
+        assert run(1) == run(16)
