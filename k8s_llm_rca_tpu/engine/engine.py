@@ -326,6 +326,104 @@ class EngineBase:
                     break
         return text
 
+    # --------------------------------------------- speculative decoding
+
+    def _spec_room_ok(self, slot: int, t: int, lengths_host) -> bool:
+        """Subclass hook: whether slot can take a T-token write this tick."""
+        return int(lengths_host[slot]) + t <= self.engine_cfg.max_seq_len
+
+    def _speculation_applies(self) -> bool:
+        """Speculate only when exact-equivalence is guaranteed and every
+        slot has cache room for the full T = k+1 token write."""
+        k = self.engine_cfg.speculative_k
+        if k <= 0 or self.engine_cfg.temperature != 0.0:
+            return False
+        lengths_host = np.asarray(self.lengths)   # ONE device sync per tick
+        return all(self._spec_room_ok(s, k + 1, lengths_host)
+                   for s in self._active)
+
+    def _greedy_with_grammar(self, st: _Active, greedy_token: int,
+                             logits_row) -> int:
+        """The token a plain greedy tick would commit: grammar force /
+        allow-mask applied to argmax, identically to the regular path.
+        ``logits_row`` is fetched lazily — only grammar slots pay for it."""
+        if st.grammar is None:
+            return greedy_token
+        c = st.grammar.constraint(self._budget_remaining(st))
+        if c.force is not None:
+            return c.force
+        if c.allow is not None:
+            masked = np.where(np.asarray(c.allow), np.asarray(logits_row),
+                              -np.inf)
+            return int(np.argmax(masked))
+        return greedy_token
+
+    def _build_drafts(self, active_slots, cur_host
+                      ) -> Tuple[np.ndarray, Dict[int, List[int]]]:
+        """n-gram prompt-lookup drafts per slot: (tokens_in [B, k+1],
+        drafts {slot: draft})."""
+        from k8s_llm_rca_tpu.engine.speculative import ngram_draft
+
+        k_spec = self.engine_cfg.speculative_k
+        tokens_in = np.zeros((self.engine_cfg.max_batch, k_spec + 1),
+                             np.int32)
+        drafts: Dict[int, List[int]] = {}
+        for slot in active_slots:
+            st = self._active[slot]
+            # _stop_context (not st.generated) so a resumed sequence's
+            # pre-preemption tokens keep the lookup context contiguous
+            ctx = self._prompts.get(st.seq_id, []) + self._stop_context(st)
+            d = ngram_draft(ctx, self.engine_cfg.speculative_ngram, k_spec)
+            drafts[slot] = d
+            tokens_in[slot, 0] = cur_host[slot]
+            tokens_in[slot, 1:1 + len(d)] = d
+        return tokens_in, drafts
+
+    def _verify_and_commit(self, active_slots, drafts, greedy_host,
+                           logits_host, post_commit=None
+                           ) -> List[SequenceResult]:
+        """Shared draft verification: commit the longest prefix of each
+        slot's draft that agrees with the model's own greedy (grammar-
+        constrained) choice, plus one bonus token from the first
+        disagreeing position.  Greedy-exact by construction."""
+        finished: List[SequenceResult] = []
+        for slot in active_slots:
+            st = self._active[slot]
+            draft = drafts[slot]
+            base_len = st.prompt_tokens + len(st.generated)
+            committed = 0
+            reason = None
+            for j in range(len(draft) + 1):
+                token = self._greedy_with_grammar(
+                    st, int(greedy_host[slot, j]),
+                    logits_host[slot, j] if logits_host is not None else None)
+                st.generated.append(token)
+                if st.grammar is not None:
+                    st.grammar.advance(token)
+                committed += 1
+                if post_commit is not None:
+                    post_commit(slot, token)
+                # cache now holds j+1 more tokens than before this commit:
+                # tokens_in[0..j] are written; token itself is written on a
+                # LATER tick (same as the regular path's current token)
+                reason = self._finish_reason(st, token, base_len + j)
+                accepted = (reason is None and j < len(draft)
+                            and token == draft[j])
+                if not accepted:
+                    break
+            METRICS.inc("engine.decode_tokens", committed)
+            METRICS.inc("engine.spec_drafted", len(draft))
+            METRICS.inc("engine.spec_accepted", max(0, committed - 1))
+            if reason is not None:
+                finished.append(self._retire(slot, reason))
+        return finished
+
+    def _need_spec_logits(self, active_slots) -> bool:
+        # full logits cross the host boundary only when a grammar slot
+        # needs a masked argmax (32000x smaller transfer otherwise)
+        return any(self._active[s].grammar is not None
+                   for s in active_slots)
+
 
 class InferenceEngine(EngineBase):
     """Single-host engine over one model replica (sharded or not)."""
@@ -557,99 +655,30 @@ class InferenceEngine(EngineBase):
 
     # --------------------------------------------- speculative decoding
 
-    def _speculation_applies(self) -> bool:
-        """Speculate only when exact-equivalence is guaranteed and every
-        slot has cache room for the full T = k+1 token write."""
-        k = self.engine_cfg.speculative_k
-        if k <= 0 or self.engine_cfg.temperature != 0.0:
-            return False
-        t = k + 1
-        lengths = np.asarray(self.lengths)
-        return all(int(lengths[s]) + t <= self.engine_cfg.max_seq_len
-                   for s in self._active)
-
-    def _greedy_with_grammar(self, st: _Active, greedy_token: int,
-                             logits_row) -> int:
-        """The token a plain greedy tick would commit: grammar force /
-        allow-mask applied to argmax, identically to the regular path.
-        ``logits_row`` is fetched lazily — only grammar slots pay for it."""
-        if st.grammar is None:
-            return greedy_token
-        c = st.grammar.constraint(self._budget_remaining(st))
-        if c.force is not None:
-            return c.force
-        if c.allow is not None:
-            masked = np.where(np.asarray(c.allow), np.asarray(logits_row),
-                              -np.inf)
-            return int(np.argmax(masked))
-        return greedy_token
-
     def _speculative_tick(self) -> List[SequenceResult]:
-        """One verification tick: draft via n-gram lookup, score all draft
-        positions in one decode_multi, commit the longest agreeing prefix
-        plus one bonus token per slot.  Greedy-exact: commits are the same
-        tokens the regular tick would produce, just more per tick."""
-        from k8s_llm_rca_tpu.engine.speculative import ngram_draft
-
-        k_spec = self.engine_cfg.speculative_k
-        t = k_spec + 1
-        b = self.engine_cfg.max_batch
+        """One verification tick on the contiguous cache: score all draft
+        positions in one decode_multi, commit via _verify_and_commit."""
         active_slots = list(self._active)
-
-        tokens_in = np.zeros((b, t), np.int32)
-        drafts: Dict[int, List[int]] = {}
         cur_host = np.asarray(self.cur_tokens)
-        for slot in active_slots:
-            st = self._active[slot]
-            ctx = self._prompts.get(st.seq_id, []) + st.generated
-            d = ngram_draft(ctx, self.engine_cfg.speculative_ngram, k_spec)
-            drafts[slot] = d
-            tokens_in[slot, 0] = cur_host[slot]
-            tokens_in[slot, 1:1 + len(d)] = d
+        tokens_in, drafts = self._build_drafts(active_slots, cur_host)
 
         with METRICS.timer("engine.decode_step"):
             self.cache, greedy, logits = self._decode_multi(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(tokens_in), self.lengths)
             greedy_host = np.asarray(greedy)                      # [B, T]
-        # full logits cross the host boundary only when a grammar slot
-        # needs a masked argmax (32000x smaller transfer otherwise)
-        need_logits = any(self._active[s].grammar is not None
-                          for s in active_slots)
-        logits_host = np.asarray(logits) if need_logits else None
+        logits_host = (np.asarray(logits)
+                       if self._need_spec_logits(active_slots) else None)
 
-        finished: List[SequenceResult] = []
         lengths_host = np.asarray(self.lengths).copy()
         next_cur = cur_host.copy()
-        for slot in active_slots:
-            st = self._active[slot]
-            draft = drafts[slot]
-            committed = 0
-            reason = None
-            for j in range(len(draft) + 1):
-                token = self._greedy_with_grammar(
-                    st, int(greedy_host[slot, j]),
-                    logits_host[slot, j] if logits_host is not None else None)
-                st.generated.append(token)
-                if st.grammar is not None:
-                    st.grammar.advance(token)
-                committed += 1
-                # cache now holds j+1 more tokens than before this commit:
-                # tokens_in[0..j] are written; token itself is written on a
-                # LATER tick (same as the regular path's current token)
-                reason = self._finish_reason(st, token,
-                                             int(lengths_host[slot]) + j + 1)
-                accepted = (reason is None and j < len(draft)
-                            and token == draft[j])
-                if not accepted:
-                    break
-            METRICS.inc("engine.decode_tokens", committed)
-            METRICS.inc("engine.spec_drafted", len(draft))
-            METRICS.inc("engine.spec_accepted", max(0, committed - 1))
-            lengths_host[slot] += committed
-            next_cur[slot] = st.generated[-1]
-            if reason is not None:
-                finished.append(self._retire(slot, reason))
+
+        def post_commit(slot: int, token: int) -> None:
+            lengths_host[slot] += 1
+            next_cur[slot] = token
+
+        finished = self._verify_and_commit(active_slots, drafts, greedy_host,
+                                           logits_host, post_commit)
         self.lengths = jnp.asarray(lengths_host)
         self.cur_tokens = jnp.asarray(next_cur)
         return finished

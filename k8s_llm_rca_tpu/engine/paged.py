@@ -318,6 +318,55 @@ def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
     return k_pages, v_pages, logits
 
 
+def paged_decode_multi(cfg: ModelConfig, params, k_pages, v_pages,
+                       tokens: jnp.ndarray, lengths: jnp.ndarray,
+                       block_tables: jnp.ndarray):
+    """Multi-token paged decode (speculative verification).
+
+    tokens [B, T]: tokens[b, 0] is the current token, the rest drafts;
+    all T writes for a slot must land in ONE page (the engine bounds T by
+    each slot's in-page room), so the page id is computed once per slot.
+    Attention runs over the gathered page view (XLA path; T queries per
+    slot don't fit the single-query Pallas kernel's grid).  Returns
+    (k_pages', v_pages', greedy [B, T], logits [B, T, V]).
+    """
+    from k8s_llm_rca_tpu.ops.attention import decode_attention_multi
+
+    b, t = tokens.shape
+    page_size = k_pages.shape[2]
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = lengths[:, None] + jnp.arange(t)[None, :]        # [B, T]
+    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+
+    page_idx = lengths // page_size
+    page_ids = jnp.take_along_axis(
+        block_tables, page_idx[:, None], axis=1)                 # [B, 1]
+    offsets = (lengths % page_size)[:, None] + jnp.arange(t)[None, :]
+    pages2d = jnp.broadcast_to(page_ids, (b, t))                 # [B, T]
+
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._qkv(cfg, layer, h, angles, positions)   # [B,T,·,d]
+        kp = k_pages[li].at[pages2d, offsets].set(
+            k.reshape(b, t, cfg.kv_dim))
+        vp = v_pages[li].at[pages2d, offsets].set(
+            v.reshape(b, t, cfg.kv_dim))
+        k_pages = k_pages.at[li].set(kp)
+        v_pages = v_pages.at[li].set(vp)
+        # gathered dense view [B, S_max, n_kv, d] for the multi-query mask
+        k_all = jnp.take(kp, block_tables, axis=0).reshape(
+            b, -1, cfg.n_kv_heads, cfg.head_dim)
+        v_all = jnp.take(vp, block_tables, axis=0).reshape(
+            b, -1, cfg.n_kv_heads, cfg.head_dim)
+        attn = decode_attention_multi(q, k_all, v_all, lengths + 1)
+        x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
+        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(cfg, layer, hm)
+
+    logits = llama._logits(cfg, params, x)                       # [B, T, V]
+    return k_pages, v_pages, jnp.argmax(logits, axis=-1), logits
+
+
 def paged_decode_scan(cfg: ModelConfig, params, k_pages, v_pages,
                       cur_tokens: jnp.ndarray, lengths: jnp.ndarray,
                       block_tables: jnp.ndarray, key, n_steps: int,
@@ -376,10 +425,6 @@ class PagedInferenceEngine(EngineBase):
     def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
                  params, tokenizer: Tokenizer,
                  use_kernel: Optional[bool] = None):
-        if engine_cfg.speculative_k > 0:
-            raise ValueError(
-                "speculative decoding is implemented for the contiguous "
-                "InferenceEngine only (set paged=False or speculative_k=0)")
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params
@@ -394,6 +439,14 @@ class PagedInferenceEngine(EngineBase):
         b = engine_cfg.max_batch
         self.page_size = engine_cfg.page_size
         self.pages_per_seq = -(-engine_cfg.max_seq_len // self.page_size)
+        if (engine_cfg.speculative_k > 0
+                and engine_cfg.speculative_k + 1 > self.page_size):
+            # _spec_room_ok could never hold: speculation would silently
+            # never fire.  Fail loudly on the impossible config instead.
+            raise ValueError(
+                f"speculative_k={engine_cfg.speculative_k} needs "
+                f"k+1 <= page_size={self.page_size} (all verify-step "
+                f"writes must fit one page)")
         if engine_cfg.num_pages - 1 < self.pages_per_seq:
             # guarantees any single sequence is admittable once the pool is
             # drained, so preemption always makes progress
@@ -437,6 +490,8 @@ class PagedInferenceEngine(EngineBase):
         self._decode_scan = jax.jit(
             paged_decode_scan, static_argnums=(0, 8, 9, 10),
             donate_argnums=donate, static_argnames=("use_kernel",))
+        self._decode_multi = jax.jit(paged_decode_multi, static_argnums=0,
+                                     donate_argnums=donate)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
 
@@ -493,6 +548,10 @@ class PagedInferenceEngine(EngineBase):
         if not active_slots:
             return finished
 
+        if self._speculation_applies():
+            finished.extend(self._speculative_tick(active_slots))
+            return finished
+
         chunk = self._scan_chunk()
         if chunk > 1:
             finished.extend(self._scan_tick(chunk, active_slots))
@@ -529,6 +588,36 @@ class PagedInferenceEngine(EngineBase):
             if reason is not None:
                 finished.append(self._retire(slot, reason))
         return finished
+
+    # --------------------------------------------- speculative decoding
+
+    def _spec_room_ok(self, slot: int, t: int, lengths_host) -> bool:
+        # all T writes must land in the slot's CURRENT page (the page id
+        # is computed once per slot in paged_decode_multi) and within the
+        # sequence cap
+        length = int(lengths_host[slot])
+        return (length % self.page_size + t <= self.page_size
+                and length + t <= self.engine_cfg.max_seq_len)
+
+    def _speculative_tick(self, active_slots) -> List[SequenceResult]:
+        """Paged verification tick: drafts scored by one paged_decode_multi,
+        committed via the shared _verify_and_commit loop."""
+        tokens_in, drafts = self._build_drafts(active_slots, self.cur_tokens)
+        with METRICS.timer("engine.decode_step"):
+            self.k_pages, self.v_pages, greedy, logits = self._decode_multi(
+                self.model_cfg, self.params, self.k_pages, self.v_pages,
+                jnp.asarray(tokens_in), jnp.asarray(self.lengths, jnp.int32),
+                jnp.asarray(self.block_tables))
+            greedy_host = np.asarray(greedy)
+        logits_host = (np.asarray(logits)
+                       if self._need_spec_logits(active_slots) else None)
+
+        def post_commit(slot: int, token: int) -> None:
+            self.lengths[slot] += 1
+            self.cur_tokens[slot] = token
+
+        return self._verify_and_commit(active_slots, drafts, greedy_host,
+                                       logits_host, post_commit)
 
     # ------------------------------------------------- chunked scan tick
 

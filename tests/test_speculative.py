@@ -125,3 +125,49 @@ class TestSpeculativeEngine:
         assert ra.token_ids == rb.token_ids
         import json
         json.loads(rb.text)      # grammar guarantee survives speculation
+
+
+class TestPagedSpeculative:
+    def _paged(self, spec_k, **kw):
+        from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+
+        cfg = TINY.replace(max_seq_len=128)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tok = get_tokenizer(vocab_size=cfg.vocab_size)
+        base = dict(max_batch=2, max_seq_len=128, page_size=16,
+                    num_pages=64, prefill_buckets=(32, 64, 128),
+                    max_new_tokens=24, temperature=0.0,
+                    speculative_k=spec_k, prefix_cache=False)
+        base.update(kw)
+        return PagedInferenceEngine(cfg, EngineConfig(**base), params, tok,
+                                    use_kernel=False), tok
+
+    def test_paged_exact_equivalence_with_plain_greedy(self):
+        plain, tok = self._paged(0)
+        spec, _ = self._paged(4)
+        prompts = [tok.encode("the pod the pod the pod the", add_bos=True),
+                   tok.encode("mount failed mount failed mount",
+                              add_bos=True)]
+        a = plain.generate([list(p) for p in prompts], max_new_tokens=20)
+        b = spec.generate([list(p) for p in prompts], max_new_tokens=20)
+        for ra, rb in zip(a, b):
+            assert ra.token_ids == rb.token_ids
+            assert ra.finish_reason == rb.finish_reason
+        spec.allocator.check()
+        assert spec.allocator.n_free == plain.allocator.n_free
+
+    def test_paged_spec_accepts_drafts(self):
+        spec, tok = self._paged(4)
+        before = METRICS.counters.get("engine.spec_accepted", 0)
+        spec.generate([tok.encode("aaaa bbbb aaaa bbbb", add_bos=True)],
+                      max_new_tokens=20)
+        assert METRICS.counters.get("engine.spec_accepted", 0) > before
+
+    def test_paged_spec_with_prefix_cache(self):
+        spec, tok = self._paged(4, prefix_cache=True)
+        prompt = tok.encode("incident pod crashloop in namespace prod "
+                            "again and again and again", add_bos=True)
+        r1 = spec.generate([list(prompt)], max_new_tokens=16)[0]
+        r2 = spec.generate([list(prompt)], max_new_tokens=16)[0]
+        assert r1.token_ids == r2.token_ids
+        spec.allocator.check()
