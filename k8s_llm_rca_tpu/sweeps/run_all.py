@@ -40,10 +40,18 @@ def main(argv=None) -> None:
                            RCAConfig(model=args.model))
 
     start = time.time()
+    failures = 0
     for message in messages:
         print("=" * 100)
         print(message)
-        result = pipeline.analyze_incident(message)
+        try:
+            result = pipeline.analyze_incident(message)
+        except Exception as e:
+            # an exhausted retry budget on one incident must not kill the
+            # sweep (run_file records failures the same way)
+            log.warning("incident failed: %s", e)
+            failures += 1
+            continue
         for analysis in result["analysis"]:
             for sp in analysis["statepath"]:
                 print("-" * 100)
@@ -51,7 +59,8 @@ def main(argv=None) -> None:
     elapsed = time.time() - start
     print("*" * 100)
     print(f"analyzed {len(messages)} incident(s) in {elapsed:.2f}s "
-          f"({elapsed / max(len(messages), 1):.2f}s per incident)")
+          f"({elapsed / max(len(messages), 1):.2f}s per incident, "
+          f"{failures} failure(s))")
     meta.close()
     state.close()
 
