@@ -1,0 +1,54 @@
+"""utils/ coverage: tokenizer roundtrip properties and the METRICS sink."""
+
+import pytest
+
+from k8s_llm_rca_tpu.utils.logging import Metrics
+from k8s_llm_rca_tpu.utils.tokenizer import ByteTokenizer, get_tokenizer
+
+
+class TestTokenizer:
+    @pytest.mark.parametrize("text", [
+        "kubelet Failed to pull image",
+        "MountVolume.SetUp failed for volume \"pv-1\": ümlaut → 中文",
+        "",
+        "```json\n{\"a\": 1}\n```",
+    ])
+    def test_roundtrip(self, text):
+        tok = get_tokenizer()
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_bos_eos_framing(self):
+        tok = get_tokenizer()
+        ids = tok.encode("x", add_bos=True, add_eos=True)
+        assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+        assert tok.bos_id != tok.eos_id
+
+    def test_count_matches_encode(self):
+        tok = get_tokenizer()
+        text = "pod pending: unschedulable (0/3 nodes available)"
+        assert tok.count(text) == len(tok.encode(text))
+
+    def test_byte_fallback_handles_any_bytes(self):
+        tok = ByteTokenizer()
+        text = bytes(range(256)).decode("latin-1")
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_ids_within_vocab(self):
+        tok = get_tokenizer(vocab_size=256)
+        ids = tok.encode("Error: ÿ boundary")
+        assert all(0 <= i < 256 for i in ids)
+
+
+class TestMetrics:
+    def test_inc_and_timer(self):
+        m = Metrics()
+        m.inc("a")
+        m.inc("a", 2)
+        assert m.count("a") == 3
+        with m.timer("t"):
+            pass
+        assert len(m.timings["t"]) == 1
+        assert m.total("t") >= 0
+        assert m.p50("t") == m.timings["t"][0]
+        snap = m.snapshot()
+        assert snap["a"] == 3 and "t.total_s" in snap
