@@ -217,6 +217,10 @@ class RCAConfig:
     metapath_max_hops: int = 3
     srckind_limit: int = 5
     state_limit: int = 10
+    # submit all per-entity audit runs before awaiting any (SURVEY §3.4:
+    # they are independent until the summary barrier), so the engine
+    # decodes them in one continuous batch; False = reference-serial order
+    concurrent_audits: bool = True
     run_timeout_s: float = 600.0
     model: str = "tiny"                # serve-side model name
     rerank_top_k: int = 0              # cap audited records when reranking (0 = all)

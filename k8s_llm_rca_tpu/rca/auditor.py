@@ -120,14 +120,11 @@ IMPORTANT_FIELDS = ("status", "spec", "path", "server", "subsets", "roleRef",
                     "metadata")
 
 
-def check_semantic(state_node, error_message: str,
-                   analyzer: GenericAssistant) -> str:
-    """One semantic LLM round-trip for one STATE node, prompt projected onto
-    the important fields to keep the context small."""
+def _semantic_prompt(state_node, error_message: str) -> str:
     projection = {k: state_node[k] for k in IMPORTANT_FIELDS
                   if state_node[k] is not None}
     kind = state_node["kind"]
-    prompt = f"""\
+    return f"""\
 The following JSON comes from a {kind} object.  Focus on the 'spec' and
 'status' fields (or other relevant fields if those are absent) and list
 clues connecting it to the error message; ignore resolutions for now.
@@ -137,13 +134,65 @@ The error message is:
 The JSON is:
 {projection}
 """
-    analyzer.add_message(prompt)
+
+
+def check_semantic(state_node, error_message: str,
+                   analyzer: GenericAssistant) -> str:
+    """One semantic LLM round-trip for one STATE node, prompt projected onto
+    the important fields to keep the context small."""
+    analyzer.add_message(_semantic_prompt(state_node, error_message))
     analyzer.run_assistant()
     messages = analyzer.wait_get_last_k_message(1)
     if messages is None:
         raise RuntimeError(
             f"analyzer run ended in state {analyzer.get_run_status().status}")
     return messages.data[0].content[0].text.value
+
+
+def submit_semantic(state_node, error_message: str,
+                    analyzer: GenericAssistant):
+    """Non-blocking variant: START the audit run on its OWN sub-thread.
+    The per-entity audits on a statepath are independent until the summary
+    barrier (SURVEY §3.4 — the reference serializes one blocking round-trip
+    per entity at reference analyze_root_cause.py:97-115); submitting them
+    all first lets the continuous-batching engine decode them in ONE batch.
+
+    A sub-thread per run (seeded with the same rule + protocol the main
+    analyzer thread carries) keeps the audits genuinely independent: on the
+    SHARED thread, a later-submitted run's prompt would contain the earlier
+    audits' still-unanswered prompts.  The sub-threads share their seeded
+    prefix, which is exactly what the paged engine's prefix cache
+    deduplicates.  The caller posts each resulting clue back to the main
+    thread as evidence for the summary run."""
+    service = analyzer.service
+    sub = service.create_thread()
+    service.add_message(sub.id, STATE_RULE)
+    service.add_message(sub.id, TASK_PROTOCOL)
+    service.add_message(sub.id, _semantic_prompt(state_node, error_message))
+    return service.create_run(sub.id, analyzer.assistant.id)
+
+
+def await_semantic(run, analyzer: GenericAssistant) -> str:
+    """Barrier for one submit_semantic run: wait, return its reply text."""
+    service = analyzer.service
+    run = service.wait_run(run.id)
+    if run.status != "completed":
+        raise RuntimeError(f"analyzer run ended in state {run.status}")
+    for m in service.list_messages(run.thread_id).data:
+        if m.id == run.response_message_id:
+            return m.content[0].text.value
+    raise RuntimeError(f"reply message for run {run.id} not found")
+
+
+def _missing_state_clue(entity_kind: str, entity_id: str,
+                        query_executor) -> str:
+    """The fabricated apparent-error clue for an entity with no STATE node
+    (single source for the serial and concurrent audit paths)."""
+    name = ad_hoc_find_entity_name(entity_kind, entity_id, query_executor)
+    return (f"{entity_kind} ({entity_id}): there is not a STATE "
+            f"({entity_kind.upper()}) node corresponding to the Entity "
+            f"({entity_kind}) node, which is an apparent error. we "
+            f"confirm that {name} does not exist.")
 
 
 def check_states_of_entity(entity_kind: str, entity_id: str,
@@ -157,12 +206,7 @@ def check_states_of_entity(entity_kind: str, entity_id: str,
         find_strict_states(entity_kind, entity_id, timestamp))
     clues: List[str] = []
     if not records:
-        entity_name = ad_hoc_find_entity_name(entity_kind, entity_id,
-                                              query_executor)
-        clue = (f"{entity_kind} ({entity_id}): there is not a STATE "
-                f"({entity_kind.upper()}) node corresponding to the Entity "
-                f"({entity_kind}) node, which is an apparent error. we "
-                f"confirm that {entity_name} does not exist.")
+        clue = _missing_state_clue(entity_kind, entity_id, query_executor)
         clues.append(clue)
         analyzer.add_message(clue)        # evidence for the summary run
     else:
@@ -222,9 +266,18 @@ def _is_node(ele) -> bool:
 
 
 def check_statepath(query_executor, analyzer: GenericAssistant,
-                    statepath) -> Tuple[str, Dict[str, List[str]]]:
+                    statepath, concurrent: bool = True
+                    ) -> Tuple[str, Dict[str, List[str]]]:
     """Audit every entity on a matched statepath record, then one summary
-    run producing the scored report.  Returns (report_text, path_clues)."""
+    run producing the scored report.  Returns (report_text, path_clues).
+
+    ``concurrent`` (default): all per-entity semantic runs are SUBMITTED
+    first and awaited at a barrier before the summary, so the engine
+    decodes them in one continuous batch instead of the reference's one
+    blocking round-trip per entity (SURVEY §3.4).  The summary run is
+    created only after the barrier, so it still sees every audit exchange
+    in the thread.  ``concurrent=False`` reproduces the reference's serial
+    order exactly."""
     timestamp = error_message = None
     for ele in statepath:
         if _is_node(ele) and ele["kind"] == "Event":
@@ -235,6 +288,7 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
 
     path_clues: Dict[str, List[str]] = {}
     kinds: List[str] = []
+    fanout: List[Tuple[str, List[Any]]] = []   # (label, clues | pending runs)
     for ele in statepath:
         if not _is_node(ele):
             continue
@@ -245,9 +299,55 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
         entity_kind = entity.entity_kind(ele)
         entity_id = ele["id"]
         kinds.append(entity_kind)
-        clues = check_states_of_entity(entity_kind, entity_id, error_message,
-                                       timestamp, query_executor, analyzer)
-        path_clues[f"{entity_kind}({entity_id})"] = clues
+        label = f"{entity_kind}({entity_id})"
+        if not concurrent:
+            path_clues[label] = check_states_of_entity(
+                entity_kind, entity_id, error_message, timestamp,
+                query_executor, analyzer)
+            continue
+        # fan-out: missing-STATE clues are synthesized inline; present
+        # STATEs get their runs submitted (on sub-threads) without waiting
+        records = query_executor.run_query(
+            find_strict_states(entity_kind, entity_id, timestamp))
+        if not records:
+            clue = _missing_state_clue(entity_kind, entity_id,
+                                       query_executor)
+            analyzer.add_message(clue)      # evidence for the summary run
+            fanout.append((label, [("clue", clue)]))
+        else:
+            fanout.append((label, [
+                ("run", record["n2"],
+                 submit_semantic(record["n2"], error_message, analyzer))
+                for record in records
+            ]))
+
+    # barrier: collect in path order; each audit clue is posted to the
+    # MAIN analyzer thread as evidence, so the summary run sees every
+    # audit (label + reply) coherently paired
+    all_runs = [item[2] for _, items in fanout for item in items
+                if item[0] == "run"]
+    try:
+        for label, items in fanout:
+            clues: List[str] = []
+            for item in items:
+                if item[0] == "clue":
+                    clues.append(item[1])
+                else:
+                    _, state_node, run = item
+                    semantic = await_semantic(run, analyzer)
+                    clue = (f"{state_node['kind'].upper()}"
+                            f"({state_node['id']}): {semantic}")
+                    clues.append(clue)
+                    analyzer.add_message(clue)
+            for clue in clues:
+                log.info("clue: %s", clue)
+            path_clues[label] = clues
+    except Exception:
+        # don't leave stragglers decoding onto the engine after a failed
+        # barrier — later incidents reuse this analyzer
+        for run in all_runs:
+            analyzer.service.cancel_run(run.id)
+        raise
 
     prompt = (
         f"Based on the previous analysis of {', '.join(kinds)}, summarize "

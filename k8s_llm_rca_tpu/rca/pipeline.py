@@ -169,7 +169,8 @@ class RCAPipeline:
                 analysis["statepath"] = []
                 for record in records:
                     report, clues = auditor.check_statepath(
-                        self.state_executor, self.analyzer, record)
+                        self.state_executor, self.analyzer, record,
+                        concurrent=self.cfg.concurrent_audits)
                     analysis["statepath"].append(
                         {"report": report, "clue": clues})
                 result["analysis"].append(analysis)
@@ -188,6 +189,9 @@ class RCAPipeline:
         u1 = self.locator.get_token_usage(tmin, tmax, sweep.locator_usage_limit)
         u2 = self.cypher_generator.get_token_usage(
             tmin, tmax, sweep.cypher_usage_limit)
-        u3 = self.analyzer.get_token_usage(
-            tmin, tmax, sweep.analyzer_usage_limit)
+        # assistant-scoped for the analyzer: concurrent audits run on
+        # sub-threads, which the thread-scoped window would miss
+        u3 = self.service.assistant_token_usage(
+            self.analyzer.assistant.id, tmin, tmax,
+            sweep.analyzer_usage_limit)
         return {k: u1[k] + u2[k] + u3[k] for k in u1}

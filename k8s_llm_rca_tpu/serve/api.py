@@ -213,6 +213,25 @@ class AssistantService:
             runs = runs[::-1]
         return runs[:limit]
 
+    def assistant_token_usage(self, assistant_id: str, tmin: int, tmax: int,
+                              limit: int = 20) -> Dict[str, int]:
+        """Windowed usage over ALL of an assistant's runs (any thread) —
+        the reference's window semantics (created_at AND completed_at in
+        [tmin, tmax), newest-first, capped) applied assistant-wide, so
+        runs on audit sub-threads stay counted."""
+        usage = {"prompt_tokens": 0, "completion_tokens": 0,
+                 "total_tokens": 0}
+        runs = [r for r in self.runs.values()
+                if r.assistant_id == assistant_id
+                and r.created_at is not None and r.completed_at is not None
+                and tmin <= r.created_at < tmax
+                and tmin <= r.completed_at < tmax]
+        for run in sorted(runs, key=lambda r: r.created_at,
+                          reverse=True)[:limit]:
+            for k in usage:
+                usage[k] += run.usage[k]
+        return usage
+
     def list_messages(self, thread_id: str, limit: Optional[int] = None
                       ) -> MessageList:
         msgs = self.threads[thread_id].messages[::-1]  # newest first

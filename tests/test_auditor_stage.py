@@ -71,3 +71,29 @@ def test_adhoc_name_for_external_entity(state):
         "Secret", "sec-0001", state) == "es-account-token"
     # unknown id falls back to the id itself
     assert auditor.ad_hoc_find_entity_name("Pod", "nope", state) == "nope"
+
+
+def test_concurrent_audits_match_serial():
+    """Fan-out/barrier audits must produce the same clues and report as
+    the reference-serial order (oracle backend is deterministic)."""
+    from k8s_llm_rca_tpu.config import RCAConfig
+    from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+    from k8s_llm_rca_tpu.graph.fixtures import (
+        INCIDENTS, build_metagraph, build_stategraph,
+    )
+    from k8s_llm_rca_tpu.rca import RCAPipeline
+    from k8s_llm_rca_tpu.rca.oracle import OracleBackend
+    from k8s_llm_rca_tpu.serve.api import AssistantService
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    def run(concurrent):
+        pipeline = RCAPipeline(
+            AssistantService(OracleBackend(get_tokenizer())),
+            InMemoryGraphExecutor(build_metagraph()),
+            InMemoryGraphExecutor(build_stategraph()),
+            RCAConfig(concurrent_audits=concurrent))
+        res = pipeline.analyze_incident(INCIDENTS[0].message)
+        return [sp["clue"] for a in res["analysis"]
+                for sp in a["statepath"]]
+
+    assert run(True) == run(False)
