@@ -490,10 +490,16 @@ class InferenceEngine(EngineBase):
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:
+            use_flash = flash_prefill_safe(params)
             self._prefill = jax.jit(
-                functools.partial(llama.prefill,
-                                  use_flash=flash_prefill_safe(params)),
+                functools.partial(llama.prefill, use_flash=use_flash),
                 static_argnums=0)
+            self._prefill_batch = jax.jit(
+                functools.partial(llama.prefill_batch, use_flash=use_flash),
+                static_argnums=0)
+        # batched admission needs the plain prefill path (prefill_cp is
+        # per-sequence)
+        self._batch_admission = cp_mesh is None
         self._decode = jax.jit(llama.decode_step, static_argnums=0)
         def _verify_step(cfg, params, cache, tokens, lengths):
             cache, logits = llama.decode_multi(cfg, params, cache, tokens,
@@ -525,9 +531,13 @@ class InferenceEngine(EngineBase):
         step for all active slots.  Returns sequences finished this tick."""
         finished: List[SequenceResult] = []
         while self._pending and self._free_slots:
-            early = self._admit(self._pending.pop(0))
-            if early is not None:        # first sampled token already terminal
-                finished.append(early)
+            group = self._admission_group()
+            if len(group) == 1:
+                early = self._admit(group[0])
+                if early is not None:    # first sampled token already terminal
+                    finished.append(early)
+            else:
+                finished.extend(self._admit_batch(group))
         if not self._active:
             return finished
 
@@ -601,16 +611,22 @@ class InferenceEngine(EngineBase):
             self._key, sub = jax.random.split(self._key)
             first = self._sample(logits, sub, self.sampling)
         METRICS.inc("engine.prefill_tokens", n)
+        return self._activate(req, slot, logits, int(first[0]))
 
+    def _activate(self, req: _Pending, slot: int, logits_1v,
+                  first_token: int) -> Optional[SequenceResult]:
+        """Shared post-prefill bookkeeping: grammar-constrain the first
+        token, register the slot, early-retire if already terminal."""
+        n = len(req.prompt_ids)
         st = _Active(
             seq_id=req.seq_id, slot=slot, prompt_tokens=n,
             max_new_tokens=req.max_new_tokens, stop_strings=req.stop_strings,
             grammar=req.grammar)
-        token = int(first[0])
+        token = first_token
         if st.grammar is not None:
             remaining = min(st.max_new_tokens,
                             self.engine_cfg.max_seq_len - n - 1)
-            token = self._grammar_first_token(st.grammar, logits, token,
+            token = self._grammar_first_token(st.grammar, logits_1v, token,
                                               remaining)
             st.grammar.advance(token)
         st.generated.append(token)
@@ -622,6 +638,60 @@ class InferenceEngine(EngineBase):
         if reason is not None:
             return self._retire(slot, reason)
         return None
+
+    def _admission_group(self) -> List[_Pending]:
+        """Pop a FIFO run of pending requests sharing one prefill bucket,
+        bounded by free slots and a batch cap — they prefill in ONE
+        dispatch (prefill_batch).  CP mode admits singly (prefill_cp is
+        per-sequence)."""
+        group = [self._pending.pop(0)]
+        if self._batch_admission:
+            b0 = self._bucket(len(group[0].prompt_ids))
+            while (self._pending and len(group) < len(self._free_slots)
+                   and len(group) < 8
+                   and self._bucket(len(self._pending[0].prompt_ids)) == b0):
+                group.append(self._pending.pop(0))
+        return group
+
+    def _admit_batch(self, reqs: List[_Pending]) -> List[SequenceResult]:
+        """Admit N same-bucket sequences with one batched prefill.  The
+        batch is padded to a power of two by repeating the last row
+        (same slot id: the duplicate scatter writes are idempotent)."""
+        n = len(reqs)
+        bucket = self._bucket(max(len(r.prompt_ids) for r in reqs))
+        n_pad = 1
+        while n_pad < n:
+            n_pad *= 2
+        slots = [self._free_slots.pop(0) for _ in range(n)]
+        tokens = np.zeros((n_pad, bucket), np.int32)
+        lens = np.zeros((n_pad,), np.int32)
+        slot_arr = np.zeros((n_pad,), np.int32)
+        for i, r in enumerate(reqs):
+            tokens[i, :len(r.prompt_ids)] = r.prompt_ids
+            lens[i] = len(r.prompt_ids)
+            slot_arr[i] = slots[i]
+        tokens[n:] = tokens[n - 1]
+        lens[n:] = lens[n - 1]
+        slot_arr[n:] = slot_arr[n - 1]
+
+        with METRICS.timer("engine.prefill"):
+            self.cache, logits = self._prefill_batch(
+                self.model_cfg, self.params, self.cache,
+                jnp.asarray(tokens), jnp.asarray(lens),
+                jnp.asarray(slot_arr))
+            self._key, sub = jax.random.split(self._key)
+            firsts = self._sample(logits, sub, self.sampling)
+        METRICS.inc("engine.prefill_tokens", int(lens[:n].sum()))
+        METRICS.inc("engine.batched_admissions", n)
+
+        finished: List[SequenceResult] = []
+        firsts_host = np.asarray(firsts)
+        for i, req in enumerate(reqs):
+            early = self._activate(req, slots[i], logits[i:i + 1],
+                                   int(firsts_host[i]))
+            if early is not None:
+                finished.append(early)
+        return finished
 
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)

@@ -578,3 +578,53 @@ def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
     new_k, new_v, logits = prefill_kv_cp(cfg, params, tokens, length, mesh,
                                          seq_axis, cp_mode)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
+
+
+def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
+                  tokens: jnp.ndarray, lengths: jnp.ndarray,
+                  slots: jnp.ndarray, use_flash: bool = False
+                  ) -> Tuple[KVCache, jnp.ndarray]:
+    """Prefill N sequences into their cache slots in ONE dispatch.
+
+    tokens [N, S_pad] right-padded; lengths [N]; slots [N] DISTINCT slot
+    ids (duplicates are allowed only for identical rows — the admission
+    batcher pads a partial batch by repeating its last real row, making
+    the duplicate scatter writes idempotent).  Returns (cache', logits
+    [N, V] at each row's last valid token).  One compile per (N, S_pad)
+    bucket pair; the engine buckets both.
+    """
+    n, s_pad = tokens.shape
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(s_pad)[None, :], (n, s_pad))
+    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+
+    attention_fn = None
+    if use_flash and s_pad >= 1024:
+        from k8s_llm_rca_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, lengths,
+                                                       interpret=False)
+
+    ks, vs = [], []
+    for layer in params["layers"]:
+        x, k, v = _block_prefill(cfg, layer, x, angles, positions, lengths,
+                                 attention_fn)
+        ks.append(k.reshape(n, s_pad, cfg.kv_dim))   # [N, S_pad, kv]
+        vs.append(v.reshape(n, s_pad, cfg.kv_dim))
+
+    new_k = jnp.stack(ks)                            # [L, N, S_pad, kv]
+    new_v = jnp.stack(vs)
+    if cache.quantized:
+        new_k, k_s = _quantize_kv(new_k)             # scales [L, N, S_pad]
+        new_v, v_s = _quantize_kv(new_v)
+        k_scale = cache.k_scale.at[:, slots, :s_pad].set(k_s)
+        v_scale = cache.v_scale.at[:, slots, :s_pad].set(v_s)
+    else:
+        k_scale, v_scale = cache.k_scale, cache.v_scale
+    k_cache = cache.k.at[:, slots, :s_pad].set(new_k)
+    v_cache = cache.v.at[:, slots, :s_pad].set(new_v)
+
+    idx = jnp.arange(n)
+    last = x[idx, lengths - 1][:, None]              # [N, 1, H]
+    logits = _logits(cfg, params, last)[:, 0]        # [N, V]
+    return KVCache(k_cache, v_cache, k_scale, v_scale), logits

@@ -265,6 +265,15 @@ def _is_node(ele) -> bool:
     return hasattr(ele, "labels") and hasattr(ele, "element_id")
 
 
+def _cancel_fanout_runs(analyzer: GenericAssistant, fanout) -> None:
+    """Cancel every submitted-but-unawaited audit run (terminal runs are a
+    no-op for cancel_run)."""
+    for _, items in fanout:
+        for item in items:
+            if item[0] == "run":
+                analyzer.service.cancel_run(item[2].id)
+
+
 def check_statepath(query_executor, analyzer: GenericAssistant,
                     statepath, concurrent: bool = True
                     ) -> Tuple[str, Dict[str, List[str]]]:
@@ -306,26 +315,33 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
                 query_executor, analyzer)
             continue
         # fan-out: missing-STATE clues are synthesized inline; present
-        # STATEs get their runs submitted (on sub-threads) without waiting
-        records = query_executor.run_query(
-            find_strict_states(entity_kind, entity_id, timestamp))
-        if not records:
-            clue = _missing_state_clue(entity_kind, entity_id,
-                                       query_executor)
-            analyzer.add_message(clue)      # evidence for the summary run
-            fanout.append((label, [("clue", clue)]))
-        else:
-            fanout.append((label, [
-                ("run", record["n2"],
-                 submit_semantic(record["n2"], error_message, analyzer))
-                for record in records
-            ]))
+        # STATEs get their runs submitted (on sub-threads) without waiting.
+        # ALL evidence posts to the main thread at the barrier, in path
+        # order (mixing fan-out-time and barrier-time posts would reorder
+        # the summary run's evidence vs the serial path).
+        try:
+            records = query_executor.run_query(
+                find_strict_states(entity_kind, entity_id, timestamp))
+            if not records:
+                clue = _missing_state_clue(entity_kind, entity_id,
+                                           query_executor)
+                fanout.append((label, [("clue", clue)]))
+            else:
+                items: List[Any] = []
+                # append incrementally so an exception mid-entity still
+                # leaves every submitted run visible to the cleanup below
+                fanout.append((label, items))
+                for record in records:
+                    run = submit_semantic(record["n2"], error_message,
+                                          analyzer)
+                    items.append(("run", record["n2"], run))
+        except Exception:
+            _cancel_fanout_runs(analyzer, fanout)
+            raise
 
-    # barrier: collect in path order; each audit clue is posted to the
-    # MAIN analyzer thread as evidence, so the summary run sees every
-    # audit (label + reply) coherently paired
-    all_runs = [item[2] for _, items in fanout for item in items
-                if item[0] == "run"]
+    # barrier: collect in path order; every clue (fabricated or audited)
+    # is posted to the MAIN analyzer thread here, so the summary run sees
+    # the evidence coherently paired and in path order
     try:
         for label, items in fanout:
             clues: List[str] = []
@@ -335,18 +351,16 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
                 else:
                     _, state_node, run = item
                     semantic = await_semantic(run, analyzer)
-                    clue = (f"{state_node['kind'].upper()}"
-                            f"({state_node['id']}): {semantic}")
-                    clues.append(clue)
-                    analyzer.add_message(clue)
+                    clues.append(f"{state_node['kind'].upper()}"
+                                 f"({state_node['id']}): {semantic}")
             for clue in clues:
+                analyzer.add_message(clue)
                 log.info("clue: %s", clue)
             path_clues[label] = clues
     except Exception:
         # don't leave stragglers decoding onto the engine after a failed
         # barrier — later incidents reuse this analyzer
-        for run in all_runs:
-            analyzer.service.cancel_run(run.id)
+        _cancel_fanout_runs(analyzer, fanout)
         raise
 
     prompt = (

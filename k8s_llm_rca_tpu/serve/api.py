@@ -221,15 +221,19 @@ class AssistantService:
         runs on audit sub-threads stay counted."""
         usage = {"prompt_tokens": 0, "completion_tokens": 0,
                  "total_tokens": 0}
-        runs = [r for r in self.runs.values()
-                if r.assistant_id == assistant_id
-                and r.created_at is not None and r.completed_at is not None
-                and tmin <= r.created_at < tmax
-                and tmin <= r.completed_at < tmax]
-        for run in sorted(runs, key=lambda r: r.created_at,
-                          reverse=True)[:limit]:
-            for k in usage:
-                usage[k] += run.usage[k]
+        # newest `limit` runs FIRST, then window-filter — the reference's
+        # order of operations (list_runs(limit) then the window test,
+        # reference common/openai_generic_assistant.py:117-135)
+        newest = sorted(
+            (r for r in self.runs.values()
+             if r.assistant_id == assistant_id and r.created_at is not None),
+            key=lambda r: r.created_at, reverse=True)[:limit]
+        for run in newest:
+            if (run.completed_at is not None
+                    and tmin <= run.created_at < tmax
+                    and tmin <= run.completed_at < tmax):
+                for k in usage:
+                    usage[k] += run.usage[k]
         return usage
 
     def list_messages(self, thread_id: str, limit: Optional[int] = None
