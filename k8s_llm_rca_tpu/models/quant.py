@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization (per-channel symmetric).
+"""Weight-only int8/int4 quantization (per-channel symmetric).
 
 Decode is dominated by streaming weights from HBM; storing matmul weights
 as int8 with a per-output-channel scale halves that traffic (and model
@@ -6,6 +6,14 @@ HBM footprint, freeing pages/slots for the KV cache) while activations
 stay bf16.  Dequantization is expressed as ``convert * scale`` right at
 the use site so XLA fuses it into the consuming matmul instead of
 materializing a dense bf16 copy.
+
+``bits=4`` halves weight bytes again: two signed 4-bit values are packed
+per int8 byte along the last axis (``QuantTensor4``) and unpacked with
+shift/mask arithmetic at the use site.  Nibble packing in int8 is used
+instead of native ``jnp.int4`` storage because S4 arrays cannot cross the
+jit/device_put boundary on every platform this framework targets, while
+int8 is universal; the unpack is elementwise VPU work that XLA fuses into
+the consuming matmul's operand read.
 
 The reference has no quantization (no model in-repo at all — its compute
 is remote GPT-4, reference common/openai_generic_assistant.py:45-51);
@@ -43,27 +51,71 @@ class QuantTensor(NamedTuple):
         return self.q.ndim
 
 
+class QuantTensor4(NamedTuple):
+    """Nibble-packed int4 weight + per-channel scale.
+
+    ``q`` packs two signed 4-bit values per int8 byte along the LAST axis
+    (even logical columns in the low nibble, odd in the high nibble);
+    ``scale`` stays at the logical (unpacked) channel size."""
+
+    q: jnp.ndarray        # int8, shape = logical shape with last dim halved
+    scale: jnp.ndarray    # compute dtype, 1s except the channel axes
+
+    @property
+    def shape(self):
+        return (*self.q.shape[:-1], self.q.shape[-1] * 2)
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def _pack_nibbles(q: jnp.ndarray) -> jnp.ndarray:
+    """int8 values in [-8, 7], even last dim -> packed int8, last dim / 2."""
+    lo, hi = q[..., 0::2], q[..., 1::2]
+    return ((hi << 4) | (lo & 0x0F)).astype(jnp.int8)
+
+
+def _unpack_nibbles(p: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of ``_pack_nibbles``: packed int8 -> sign-extended int8."""
+    lo = jnp.bitwise_and(p, jnp.int8(0x0F))
+    lo = jnp.where(lo >= 8, lo - 16, lo)            # sign-extend low nibble
+    hi = jnp.right_shift(p, 4)                       # arithmetic: sign-extends
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        *p.shape[:-1], p.shape[-1] * 2).astype(jnp.int8)
+
+
 def quantize(w: jnp.ndarray, axis=-1,
-             compute_dtype: Optional[jnp.dtype] = None) -> QuantTensor:
-    """Symmetric per-channel int8: scale = max|w| / 127 reduced over every
-    axis NOT in ``axis`` (an int or tuple of surviving channel axes —
+             compute_dtype: Optional[jnp.dtype] = None,
+             bits: int = 8) -> "QuantTensor | QuantTensor4":
+    """Symmetric per-channel int8/int4: scale = max|w| / qmax reduced over
+    every axis NOT in ``axis`` (an int or tuple of surviving channel axes —
     e.g. (0, -1) for stacked expert weights, so each (expert, column)
     pair gets its own scale instead of sharing across experts)."""
+    assert bits in (8, 4), f"bits must be 8 or 4, got {bits}"
     compute_dtype = compute_dtype or w.dtype
     keep = {a % w.ndim for a in ((axis,) if isinstance(axis, int) else axis)}
     reduce_axes = tuple(i for i in range(w.ndim) if i not in keep)
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
                    keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    qmax = 127.0 if bits == 8 else 7.0
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax)
+    if bits == 4:
+        assert w.shape[-1] % 2 == 0, (
+            f"int4 packing needs an even last dim, got {w.shape}")
+        return QuantTensor4(q=_pack_nibbles(q.astype(jnp.int8)),
+                            scale=scale.astype(compute_dtype))
     return QuantTensor(q=q.astype(jnp.int8),
                        scale=scale.astype(compute_dtype))
 
 
 def dq(w: Any) -> jnp.ndarray:
-    """Dequantize a QuantTensor; pass plain arrays through unchanged."""
+    """Dequantize a QuantTensor/QuantTensor4; pass plain arrays through."""
     if isinstance(w, QuantTensor):
         return w.q.astype(w.scale.dtype) * w.scale
+    if isinstance(w, QuantTensor4):
+        return _unpack_nibbles(w.q).astype(w.scale.dtype) * w.scale
     return w
 
 
@@ -72,13 +124,16 @@ def gather_rows(w: Any, idx: jnp.ndarray) -> jnp.ndarray:
     dequantized table: gathers int8 rows and their row scales.  Requires
     the table to be quantized with axis=0 (per-row), which is also the
     right channel axis for its use as the tied LM head."""
-    if isinstance(w, QuantTensor):
+    if isinstance(w, (QuantTensor, QuantTensor4)):
         # fail loudly on a per-column table: scale[idx] would be an
         # out-of-bounds gather that JAX silently clamps to row 0
         assert w.scale.shape[0] == w.q.shape[0], (
             f"gather_rows needs per-row scales (axis=0 quantization); got "
             f"scale {w.scale.shape} for table {w.q.shape}")
-        return w.q[idx].astype(w.scale.dtype) * w.scale[idx]
+        rows = w.q[idx]
+        if isinstance(w, QuantTensor4):
+            rows = _unpack_nibbles(rows)
+        return rows.astype(w.scale.dtype) * w.scale[idx]
     return w[idx]
 
 
@@ -86,16 +141,26 @@ def gather_rows(w: Any, idx: jnp.ndarray) -> jnp.ndarray:
 _ROW_QUANT = ("embedding", "lm_head")
 
 
-def quantize_params(params: Any, compute_dtype=jnp.bfloat16) -> Any:
+def quantize_params(params: Any, compute_dtype=jnp.bfloat16,
+                    bits: int = 8) -> Any:
     """Quantize every rank>=2 weight of a model param tree.
 
     1-D tensors (norm gains, biases) and integer arrays stay as-is.
     ``embedding``/``lm_head`` use per-row scales (valid for both the
     token gather and the output projection, whose channel axis is the
     vocab row); everything else uses per-output-column scales (last axis).
+    ``bits=4`` nibble-packs (see module docstring).
     """
     def _quantize_entry(path, w):
-        if isinstance(w, QuantTensor):          # idempotent
+        if isinstance(w, (QuantTensor, QuantTensor4)):      # idempotent
+            # ... but only at the SAME width: silently passing an int8 tree
+            # through a bits=4 request would hand the caller double the
+            # HBM it budgeted for
+            have = 4 if isinstance(w, QuantTensor4) else 8
+            assert have == bits, (
+                f"param at {jax.tree_util.keystr(path)} is already "
+                f"int{have}-quantized; re-quantizing to int{bits} is not "
+                f"supported (dequantize first)")
             return w
         if not isinstance(w, jnp.ndarray) or w.ndim < 2:
             return w
@@ -107,19 +172,19 @@ def quantize_params(params: Any, compute_dtype=jnp.bfloat16) -> Any:
             axis = (0, -1)                # stacked experts: per (e, column)
         else:
             axis = -1                     # per output column
-        return quantize(w, axis=axis, compute_dtype=compute_dtype)
+        return quantize(w, axis=axis, compute_dtype=compute_dtype, bits=bits)
 
     return jax.tree_util.tree_map_with_path(
         _quantize_entry, params,
-        is_leaf=lambda x: isinstance(x, QuantTensor))
+        is_leaf=lambda x: isinstance(x, (QuantTensor, QuantTensor4)))
 
 
-def quantizing_transform(compute_dtype=jnp.bfloat16):
+def quantizing_transform(compute_dtype=jnp.bfloat16, bits: int = 8):
     """tensor_transform for ``llama.init_params``: quantize every matmul
-    weight as it is created, so peak HBM tracks the int8 model size.
+    weight as it is created, so peak HBM tracks the quantized model size.
     The ``axis`` hint from init_params selects per-row (embedding/head),
     per-(expert, column) (stacked experts) or per-column scales."""
     def transform(w, axis=-1):
-        return quantize(w, axis=axis, compute_dtype=compute_dtype)
+        return quantize(w, axis=axis, compute_dtype=compute_dtype, bits=bits)
 
     return transform

@@ -155,6 +155,110 @@ def test_expert_parallel_moe_quantized(monkeypatch):
     assert corr > 0.99
 
 
+def test_int4_pack_unpack_roundtrip():
+    from k8s_llm_rca_tpu.models.quant import _pack_nibbles, _unpack_nibbles
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.integers(-8, 8, (6, 32)), jnp.int8)
+    packed = _pack_nibbles(q)
+    assert packed.shape == (6, 16) and packed.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(_unpack_nibbles(packed)),
+                                  np.asarray(q))
+
+
+def test_int4_quantize_roundtrip_error_bound():
+    from k8s_llm_rca_tpu.models.quant import QuantTensor4
+
+    w = jax.random.normal(jax.random.PRNGKey(6), (64, 128), jnp.float32)
+    qt = quantize(w, axis=-1, compute_dtype=jnp.float32, bits=4)
+    assert isinstance(qt, QuantTensor4)
+    assert qt.q.shape == (64, 64) and qt.shape == (64, 128)
+    assert qt.scale.shape == (1, 128)
+    err = jnp.max(jnp.abs(dq(qt) - w))
+    # per-channel symmetric at 4 bits: max error is half a step of amax/7
+    step = jnp.max(jnp.abs(w), axis=0) / 7.0
+    assert float(err) <= float(jnp.max(step)) * 0.5 + 1e-6
+
+
+def test_int4_rejects_odd_last_dim():
+    import pytest
+
+    with pytest.raises(AssertionError, match="even last dim"):
+        quantize(jnp.ones((4, 7)), bits=4)
+
+
+def test_int4_row_quantized_gather_matches_dense():
+    w = jax.random.normal(jax.random.PRNGKey(7), (50, 16), jnp.float32)
+    qt = quantize(w, axis=0, compute_dtype=jnp.float32, bits=4)
+    idx = jnp.asarray([[3, 7], [49, 0]])
+    np.testing.assert_allclose(np.asarray(gather_rows(qt, idx)),
+                               np.asarray(dq(qt)[idx]), rtol=1e-6, atol=1e-6)
+
+
+def test_int4_forward_correlates_with_fp():
+    cfg = TINY
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_params(params, compute_dtype=jnp.float32, bits=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 24), 0,
+                                cfg.vocab_size)
+    ref = llama.forward(cfg, params, tokens)
+    got = llama.forward(cfg, qp, tokens)
+    assert np.isfinite(np.asarray(got)).all()
+    corr = np.corrcoef(np.asarray(ref).ravel(), np.asarray(got).ravel())[0, 1]
+    # 4-bit noise is substantially larger than 8-bit but structure must hold
+    assert corr > 0.9, corr
+
+
+def test_int4_quantize_params_idempotent_and_moe_scales():
+    from k8s_llm_rca_tpu.models.quant import QuantTensor4
+
+    params = llama.init_params(TINY_MOE, jax.random.PRNGKey(0))
+    qp = quantize_params(params, bits=4)
+    qp2 = quantize_params(qp, bits=4)
+    gate = qp2["layers"][0]["w_gate"]
+    assert isinstance(gate, QuantTensor4)
+    assert gate.scale.shape[0] == TINY_MOE.n_experts   # per-expert scales
+    assert gate.q.shape[-1] == TINY_MOE.intermediate_size // 2
+    assert not isinstance(qp2["layers"][0]["attn_norm"], QuantTensor4)
+
+
+def test_quantize_params_rejects_width_change():
+    import pytest
+
+    params = llama.init_params(TINY, jax.random.PRNGKey(0))
+    qp8 = quantize_params(params, bits=8)
+    with pytest.raises(AssertionError, match="already int8"):
+        quantize_params(qp8, bits=4)
+
+
+def test_int4_engine_generates():
+    cfg = TINY.replace(max_seq_len=64)
+    params = quantize_params(llama.init_params(cfg, jax.random.PRNGKey(0)),
+                             bits=4)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    eng = InferenceEngine(cfg, ecfg, params, tok)
+    res = eng.generate([tok.encode("pod oom", add_bos=True)],
+                       max_new_tokens=6)
+    assert res[0].completion_tokens == 6
+
+
+def test_int4_quantizing_transform_streaming_init():
+    from k8s_llm_rca_tpu.models.quant import QuantTensor4, quantizing_transform
+
+    cfg = TINY
+    params = llama.init_params(cfg, jax.random.PRNGKey(0),
+                               tensor_transform=quantizing_transform(bits=4))
+    assert isinstance(params["layers"][0]["wq"], QuantTensor4)
+    assert isinstance(params["embedding"], QuantTensor4)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (1, 8), 0,
+                                cfg.vocab_size)
+    out = llama.forward(cfg, params, tokens)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_moe_experts_get_per_expert_scales():
     # [E, H, I] expert stacks must not share one scale across experts
     w = jnp.stack([jnp.ones((8, 16)) * 0.01,
