@@ -1,4 +1,6 @@
 from k8s_llm_rca_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 from k8s_llm_rca_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
-from k8s_llm_rca_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from k8s_llm_rca_tpu.parallel.pipeline import (  # noqa: F401
+    llama_pipeline_forward, pipeline_apply, stack_llama_stages,
+)
 from k8s_llm_rca_tpu.parallel.moe import expert_parallel_moe  # noqa: F401

@@ -60,6 +60,67 @@ def _pipeline_local(stage_params, x_mb, fn: Callable, axis_name: str):
     return jax.lax.psum(contrib, axis_name)
 
 
+def stack_llama_stages(params: Any, n_stages: int) -> Any:
+    """Regroup a llama param tree's layer list into a [P, L/P, ...] stacked
+    pytree for ``pipeline_apply``: stage i holds layers [i*L/P, (i+1)*L/P).
+    """
+    layers = params["layers"]
+    assert len(layers) % n_stages == 0, (
+        f"{len(layers)} layers do not divide into {n_stages} stages")
+    per = len(layers) // n_stages
+    stages = [
+        jax.tree.map(lambda *xs: jnp.stack(xs), *layers[i * per:(i + 1) * per])
+        for i in range(n_stages)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def llama_pipeline_forward(cfg, params: Any, tokens: jnp.ndarray, mesh: Mesh,
+                           microbatches: int,
+                           stage_axis: str = "stage",
+                           stacked_layers: Any = None) -> jnp.ndarray:
+    """Pipeline-parallel llama scoring forward: the transformer blocks are
+    split into ``mesh.shape[stage_axis]`` stages and microbatched through
+    ``pipeline_apply``; embedding lookup and the LM head run replicated
+    outside the pipeline (they are <5% of FLOPs and keep the stage function
+    uniform).  Matches ``models.llama.forward`` exactly on full-length
+    sequences.  Reference has no model parallelism of any kind (SURVEY §2.2
+    PP row); this is the DCN-friendly layer-stage axis for multi-host pods.
+
+    Restacking the layer weights is O(model size); repeated callers should
+    hoist it once via ``stack_llama_stages`` and pass ``stacked_layers``.
+    """
+    from k8s_llm_rca_tpu.models import llama as L
+
+    b, s = tokens.shape
+    assert b % microbatches == 0, (
+        f"batch {b} must divide into {microbatches} microbatches")
+    n_stages = mesh.shape[stage_axis]
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+
+    x = L.gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+    x_mb = x.reshape(microbatches, b // microbatches, s, x.shape[-1])
+
+    def stage_fn(stage_layers, h):
+        mb, s_, _ = h.shape
+        angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        positions = jnp.broadcast_to(jnp.arange(s_)[None, :], (mb, s_))
+        seq_lens = jnp.full((mb,), s_, jnp.int32)
+
+        def body(carry, layer):
+            carry, _, _ = L._block_prefill(cfg, layer, carry, angles,
+                                           positions, seq_lens)
+            return carry, None
+
+        h, _ = jax.lax.scan(body, h, stage_layers)
+        return h
+
+    out = pipeline_apply(stage_fn, stacked, x_mb, mesh, stage_axis)
+    return L._logits(cfg, params, out.reshape(b, s, -1))
+
+
 def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stacked_params: Any, x_mb: jnp.ndarray, mesh: Mesh,
                    stage_axis: str = "stage") -> jnp.ndarray:

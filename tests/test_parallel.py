@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from k8s_llm_rca_tpu.config import TINY_MOE, MeshConfig
+from k8s_llm_rca_tpu.config import TINY, TINY_MOE, MeshConfig
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.ops.attention import causal_attention
 from k8s_llm_rca_tpu.parallel import (
@@ -79,6 +79,72 @@ def test_pipeline_matches_sequential(cpu_devices):
         ref = stage_fn(jax.tree.map(lambda a, i=i: a[i], stacked), ref)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_llama_pipeline_forward_matches_sequential(cpu_devices):
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.parallel import llama_pipeline_forward
+
+    cfg = TINY.replace(n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(6))
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (8, 16), 0,
+                                cfg.vocab_size)
+    ref = llama.forward(cfg, params, tokens)
+    for n_stages, microbatches in ((4, 4), (2, 2)):
+        mesh = build_mesh(MeshConfig(stage=n_stages),
+                          devices=cpu_devices[:n_stages])
+        out = llama_pipeline_forward(cfg, params, tokens, mesh,
+                                     microbatches=microbatches)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_llama_pipeline_prestacked_layers_match(cpu_devices):
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.parallel import (
+        llama_pipeline_forward, stack_llama_stages,
+    )
+
+    cfg = TINY.replace(n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(11))
+    tokens = jax.random.randint(jax.random.PRNGKey(12), (4, 8), 0,
+                                cfg.vocab_size)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    stacked = stack_llama_stages(params, 2)    # hoisted once by the caller
+    out = llama_pipeline_forward(cfg, params, tokens, mesh, microbatches=2,
+                                 stacked_layers=stacked)
+    ref = llama_pipeline_forward(cfg, params, tokens, mesh, microbatches=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_llama_pipeline_forward_quantized(cpu_devices):
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.models.quant import quantize_params
+    from k8s_llm_rca_tpu.parallel import llama_pipeline_forward
+
+    cfg = TINY.replace(n_layers=4)
+    params = quantize_params(llama.init_params(cfg, jax.random.PRNGKey(8)),
+                             compute_dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (4, 12), 0,
+                                cfg.vocab_size)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    out = llama_pipeline_forward(cfg, params, tokens, mesh, microbatches=2)
+    ref = llama.forward(cfg, params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_llama_pipeline_rejects_indivisible_layers(cpu_devices):
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.parallel import llama_pipeline_forward
+
+    cfg = TINY.replace(n_layers=3)
+    params = llama.init_params(cfg, jax.random.PRNGKey(10))
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    with pytest.raises(AssertionError, match="stages"):
+        llama_pipeline_forward(cfg, params, tokens, mesh, microbatches=2)
 
 
 def test_expert_parallel_moe_matches_dense(cpu_devices):
