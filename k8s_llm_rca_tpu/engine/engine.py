@@ -466,14 +466,14 @@ class InferenceEngine(EngineBase):
         )
 
         b = engine_cfg.max_batch
-        if engine_cfg.kv_cache_dtype not in (None, "int8"):
+        if engine_cfg.kv_cache_dtype not in (None, "int8", "int4"):
             raise ValueError(
                 f"unsupported kv_cache_dtype {engine_cfg.kv_cache_dtype!r} "
-                f"(None or 'int8')")
+                f"(None, 'int8' or 'int4')")
         self.cache = llama.init_cache(
             model_cfg, b, engine_cfg.max_seq_len,
-            kv_dtype=jnp.int8 if engine_cfg.kv_cache_dtype == "int8"
-            else None)
+            kv_dtype={"int8": jnp.int8, "int4": "int4", None: None}[
+                engine_cfg.kv_cache_dtype])
         self.lengths = jnp.zeros((b,), jnp.int32)
         self.cur_tokens = jnp.zeros((b,), jnp.int32)
         self._key = jax.random.PRNGKey(engine_cfg.seed)

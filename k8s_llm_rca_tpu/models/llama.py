@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 
 from k8s_llm_rca_tpu.config import ModelConfig
-from k8s_llm_rca_tpu.models.quant import dq, gather_rows
+from k8s_llm_rca_tpu.models.quant import (
+    _pack_nibbles, _unpack_nibbles, dq, gather_rows,
+)
 from k8s_llm_rca_tpu.ops.attention import (
     causal_attention, decode_attention, decode_attention_multi,
 )
@@ -52,6 +54,13 @@ class KVCache(NamedTuple):
     cache HBM and attention read bandwidth at a small quantization cost.
     Scales are per-token scalars, not per-head, because a [..., S, n_kv]
     scale array would pad n_kv=4 -> 128 lanes and eat the savings.
+
+    Optional int4 mode (``init_cache(kv_dtype="int4")``): same per-token
+    scalar scales, but k/v are nibble-PACKED int8 of shape
+    [L, B, S_max, kv_dim/2] — two signed 4-bit values per byte along the
+    merged kv axis (``models.quant._pack_nibbles``), quartering bf16 cache
+    bytes.  The halved last dim is the discriminator: ``_kv_packed(cfg,
+    cache)`` is how read/write sites choose the unpack path.
     """
 
     k: jnp.ndarray
@@ -158,6 +167,15 @@ def init_cache(cfg: ModelConfig, n_slots: int,
         raise ValueError(
             f"cache max_seq_len {s} exceeds model max_seq_len {cfg.max_seq_len}")
     shape = (cfg.n_layers, n_slots, s, cfg.kv_dim)
+    if isinstance(kv_dtype, str) and kv_dtype == "int4":
+        # nibble-packed: two 4-bit values per byte along kv_dim (quarter
+        # the bf16 cache bytes); per-token scalar scales as in int8 mode
+        assert cfg.kv_dim % 2 == 0
+        pshape = (*shape[:3], cfg.kv_dim // 2)
+        return KVCache(k=jnp.zeros(pshape, jnp.int8),
+                       v=jnp.zeros(pshape, jnp.int8),
+                       k_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)),
+                       v_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)))
     if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
         # two DISTINCT buffers: aliasing one zeros array as both scales
         # would donate the same buffer twice under donate_argnums
@@ -167,6 +185,11 @@ def init_cache(cfg: ModelConfig, n_slots: int,
                        v_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)))
     dtype = jnp.dtype(kv_dtype or cfg.dtype)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _kv_packed(cfg: ModelConfig, cache: KVCache) -> bool:
+    """True when the cache stores nibble-packed int4 KV (kv_dim halved)."""
+    return cache.k.shape[-1] != cfg.kv_dim
 
 
 # ---------------------------------------------------------------------------
@@ -240,22 +263,33 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
     return x, k, v
 
 
-def _quantize_kv(kv: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-token int8: kv [..., kv_dim] -> (int8 same shape, scale [...])."""
+def _quantize_kv(kv: jnp.ndarray, packed: bool = False
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token int8 (or nibble-packed int4 when ``packed``): kv
+    [..., kv_dim] -> (int8 [..., kv_dim] | packed int8 [..., kv_dim/2],
+    scale [...]).  The scale stays a per-token SCALAR in both modes: any
+    trailing group axis would lane-pad to 128 on TPU and eat the savings
+    (see KVCache docstring)."""
+    qmax = 7.0 if packed else 127.0
     amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
     q = jnp.clip(jnp.round(kv.astype(jnp.float32) / scale[..., None]),
-                 -127, 127)
-    return q.astype(jnp.int8), scale.astype(kv.dtype)
+                 -qmax, qmax).astype(jnp.int8)
+    if packed:
+        q = _pack_nibbles(q)
+    return q, scale.astype(kv.dtype)
 
 
 def _dequant_layer(k_cache: jnp.ndarray, scale: Optional[jnp.ndarray],
-                   dtype) -> jnp.ndarray:
-    """[B, S, kv_dim] int8 + [B, S] scale -> dtype (identity when scale is
-    None).  Expressed as convert*scale at the read site for XLA to fuse
-    into the attention einsum."""
+                   dtype, packed: bool = False) -> jnp.ndarray:
+    """[B, S, kv_dim] int8 (or [B, S, kv_dim/2] packed int4) + [B, S]
+    scale -> dtype (identity when scale is None).  Expressed as
+    convert*scale (plus shift/mask unpack for int4) at the read site for
+    XLA to fuse into the attention einsum."""
     if scale is None:
         return k_cache
+    if packed:
+        k_cache = _unpack_nibbles(k_cache)
     return k_cache.astype(dtype) * scale[..., None].astype(dtype)
 
 
@@ -267,8 +301,9 @@ def _write_prefill_kv(cfg: ModelConfig, cache: KVCache, new_k, new_v,
     new_k = new_k.reshape(L, 1, s_pad, cfg.kv_dim)
     new_v = new_v.reshape(L, 1, s_pad, cfg.kv_dim)
     if cache.quantized:
-        new_k, ks = _quantize_kv(new_k)
-        new_v, vs = _quantize_kv(new_v)
+        packed = _kv_packed(cfg, cache)
+        new_k, ks = _quantize_kv(new_k, packed)
+        new_v, vs = _quantize_kv(new_v, packed)
         k_scale = jax.lax.dynamic_update_slice(cache.k_scale, ks,
                                                (0, slot, 0))
         v_scale = jax.lax.dynamic_update_slice(cache.v_scale, vs,
@@ -391,8 +426,9 @@ def _store_layer_kv(cache: KVCache, li: int, k_new: jnp.ndarray,
     write_kv = _write_tokens_kv if multi else _write_token_kv
     write_s = _write_tokens_scale if multi else _write_token_scale
     if cache.quantized:
-        k_q, k_s = _quantize_kv(k_new)
-        v_q, v_s = _quantize_kv(v_new)
+        packed = cache.k.shape[-1] != k_new.shape[-1]
+        k_q, k_s = _quantize_kv(k_new, packed)
+        v_q, v_s = _quantize_kv(v_new, packed)
         return (write_kv(cache.k[li], k_q, lengths),
                 write_kv(cache.v[li], v_q, lengths),
                 write_s(cache.k_scale[li], k_s, lengths),
@@ -417,6 +453,7 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
 
     s_max = cache.max_seq_len
     dtype = jnp.dtype(cfg.dtype)
+    packed = _kv_packed(cfg, cache)
     new_ks, new_vs, new_kss, new_vss = [], [], [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
@@ -430,9 +467,9 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
         new_vss.append(v_s)
         attn = decode_attention(
             q,
-            _dequant_layer(k_cache, k_s, dtype).reshape(
+            _dequant_layer(k_cache, k_s, dtype, packed).reshape(
                 b, s_max, cfg.n_kv_heads, cfg.head_dim),
-            _dequant_layer(v_cache, v_s, dtype).reshape(
+            _dequant_layer(v_cache, v_s, dtype, packed).reshape(
                 b, s_max, cfg.n_kv_heads, cfg.head_dim),
             lengths + 1)
         x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
@@ -488,6 +525,7 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
     x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
 
     dtype = jnp.dtype(cfg.dtype)
+    packed = _kv_packed(cfg, cache)
     new_ks, new_vs, new_kss, new_vss = [], [], [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
@@ -501,9 +539,9 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
         new_vss.append(v_s)
         attn = decode_attention_multi(
             q,
-            _dequant_layer(k_cache, k_s, dtype).reshape(
+            _dequant_layer(k_cache, k_s, dtype, packed).reshape(
                 b, s_max, cfg.n_kv_heads, cfg.head_dim),
-            _dequant_layer(v_cache, v_s, dtype).reshape(
+            _dequant_layer(v_cache, v_s, dtype, packed).reshape(
                 b, s_max, cfg.n_kv_heads, cfg.head_dim),
             lengths + 1)
         x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
@@ -615,8 +653,9 @@ def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
     new_k = jnp.stack(ks)                            # [L, N, S_pad, kv]
     new_v = jnp.stack(vs)
     if cache.quantized:
-        new_k, k_s = _quantize_kv(new_k)             # scales [L, N, S_pad]
-        new_v, v_s = _quantize_kv(new_v)
+        packed = _kv_packed(cfg, cache)
+        new_k, k_s = _quantize_kv(new_k, packed)     # scales [L, N, S_pad]
+        new_v, v_s = _quantize_kv(new_v, packed)
         k_scale = cache.k_scale.at[:, slots, :s_pad].set(k_s)
         v_scale = cache.v_scale.at[:, slots, :s_pad].set(v_s)
     else:

@@ -1,4 +1,4 @@
-"""int8 KV cache: numerics and engine mechanics."""
+"""int8/int4 KV cache: numerics and engine mechanics."""
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +61,64 @@ def test_engine_with_int8_kv_cache():
                        max_new_tokens=6)
     assert all(r.completion_tokens == 6 for r in res)
     assert eng.cache.quantized
+
+
+def test_int4_cache_correlates_with_full_precision():
+    cfg = TINY.replace(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = list(range(5, 25))
+    full = _decode_chain(cfg, params,
+                         llama.init_cache(cfg, 1, 64), prompt, 6)
+    q = _decode_chain(cfg, params,
+                      llama.init_cache(cfg, 1, 64, kv_dtype="int4"),
+                      prompt, 6)
+    assert np.isfinite(q).all()
+    # 4-bit KV with per-token scalar scales: noisier than int8 but the
+    # logit structure must survive
+    corr = np.corrcoef(full.ravel(), q.ravel())[0, 1]
+    assert corr > 0.95, corr
+
+
+def test_int4_cache_shapes_and_flag():
+    cfg = TINY
+    c = llama.init_cache(cfg, 2, 32, kv_dtype="int4")
+    assert c.quantized and c.k.dtype == jnp.int8
+    assert c.k.shape == (cfg.n_layers, 2, 32, cfg.kv_dim // 2)  # packed
+    assert c.k_scale.shape == (cfg.n_layers, 2, 32)
+    assert llama._kv_packed(cfg, c)
+    assert not llama._kv_packed(cfg, llama.init_cache(cfg, 2, 32,
+                                                      kv_dtype=jnp.int8))
+
+
+def test_engine_with_int4_kv_cache():
+    cfg = TINY.replace(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    eng = InferenceEngine(
+        cfg, EngineConfig(max_batch=2, max_seq_len=64,
+                          prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                          temperature=0.0, kv_cache_dtype="int4"),
+        params, tok)
+    res = eng.generate([tok.encode("pod oom killed", add_bos=True),
+                        tok.encode("pvc pending", add_bos=True)],
+                       max_new_tokens=6)
+    assert all(r.completion_tokens == 6 for r in res)
+    assert eng.cache.quantized and eng.cache.k.shape[-1] == cfg.kv_dim // 2
+
+
+def test_int4_cache_speculative_tick_runs():
+    cfg = TINY.replace(max_seq_len=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    eng = InferenceEngine(
+        cfg, EngineConfig(max_batch=1, max_seq_len=128,
+                          prefill_buckets=(32, 64, 128), max_new_tokens=12,
+                          temperature=0.0, kv_cache_dtype="int4",
+                          speculative_k=4),
+        params, tok)
+    r = eng.generate([tok.encode("aaaa bbbb aaaa bbbb", add_bos=True)],
+                     max_new_tokens=12)[0]
+    assert r.completion_tokens == 12
 
 
 def test_int8_cache_speculative_tick_runs():
