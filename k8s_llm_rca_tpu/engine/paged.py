@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import functools
 import itertools
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,7 @@ from k8s_llm_rca_tpu.engine.sampling import (
 )
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.models.quant import dq, gather_rows
+from k8s_llm_rca_tpu.models.llama import _quantize_kv
 from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.paged_attention import (
     paged_attention, paged_attention_xla,
@@ -158,13 +159,94 @@ def make_allocator(n_pages: int, prefer_native: bool = True):
 # ---------------------------------------------------------------------------
 
 
-def init_paged_cache(cfg: ModelConfig, n_pages: int, page_size: int):
+class PagePool(NamedTuple):
+    """Paged KV pool: k/v [L, n_pages, page_size, kv_dim].
+
+    Quantized modes mirror models.llama.KVCache: int8 stores k/v as int8
+    with one dynamic scale per written token (``k_scale``/``v_scale``
+    [L, n_pages, page_size]); "int4" additionally nibble-packs two signed
+    4-bit values per byte along kv_dim (k/v [..., kv_dim/2], the halved
+    last dim is the discriminator).  The scale pools' trailing page_size
+    axis lane-pads to 128, but at 2 bytes/token/layer they are noise next
+    to the page payload.  Page ids index k/v and the scale pools
+    identically, so block-table sharing (prefix cache) and page transfer
+    need no extra bookkeeping.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def init_paged_cache(cfg: ModelConfig, n_pages: int, page_size: int,
+                     kv_dtype=None) -> PagePool:
     shape = (cfg.n_layers, n_pages, page_size, cfg.kv_dim)
+    if isinstance(kv_dtype, str) and kv_dtype == "int4":
+        assert cfg.kv_dim % 2 == 0
+        pshape = (*shape[:3], cfg.kv_dim // 2)
+        return PagePool(k=jnp.zeros(pshape, jnp.int8),
+                        v=jnp.zeros(pshape, jnp.int8),
+                        k_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)),
+                        v_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)))
+    if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
+        return PagePool(k=jnp.zeros(shape, jnp.int8),
+                        v=jnp.zeros(shape, jnp.int8),
+                        k_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)),
+                        v_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)))
     dtype = jnp.dtype(cfg.dtype)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    return PagePool(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
+def _pool_packed(cfg: ModelConfig, pool: PagePool) -> bool:
+    """True when the pool stores nibble-packed int4 KV (kv_dim halved)."""
+    return pool.k.shape[-1] != cfg.kv_dim
+
+
+def _gather_dequant_pages(pages: jnp.ndarray, scales: Optional[jnp.ndarray],
+                          block_tables: jnp.ndarray, n_kv: int, d: int,
+                          dtype, packed: bool) -> jnp.ndarray:
+    """Gather a dense per-sequence KV view [B, S_max, n_kv, d] from the
+    pool, dequantizing (unpack + per-token scale) when quantized."""
+    b = block_tables.shape[0]
+    kv = jnp.take(pages, block_tables, axis=0)      # [B, pp, page, kv']
+    s = (jnp.take(scales, block_tables, axis=0)     # [B, pp, page]
+         if scales is not None else None)
+    kv = llama._dequant_layer(kv, s, dtype, packed)
+    return kv.reshape(b, -1, n_kv, d)
+
+
+def _write_pool_pages(cfg: ModelConfig, pool: PagePool, new_k, new_v,
+                      page_map: jnp.ndarray, n_seq_pages: int,
+                      page_size: int) -> PagePool:
+    """Scatter [L, S_pad, n_kv, d] prefill KV into ``page_map`` pool pages,
+    quantizing per token first when the pool is quantized (shared by the
+    full and chunked prefill paths)."""
+    def to_pages(a, last):
+        return a.reshape(a.shape[0], n_seq_pages, page_size, last)
+
+    k_scale, v_scale = pool.k_scale, pool.v_scale
+    new_k = to_pages(new_k, cfg.kv_dim)
+    new_v = to_pages(new_v, cfg.kv_dim)
+    if pool.quantized:
+        packed = _pool_packed(cfg, pool)
+        new_k, ks = _quantize_kv(new_k, packed)
+        new_v, vs = _quantize_kv(new_v, packed)
+        k_scale = k_scale.at[:, page_map].set(ks)
+        v_scale = v_scale.at[:, page_map].set(vs)
+    return PagePool(pool.k.at[:, page_map].set(new_k),
+                    pool.v.at[:, page_map].set(new_v), k_scale, v_scale)
+
+
+def paged_prefill(cfg: ModelConfig, params, pool: PagePool,
                   tokens: jnp.ndarray, length: jnp.ndarray,
                   page_map: jnp.ndarray, use_flash: bool = False):
     """Prefill ONE sequence, scattering its KV into ``page_map`` pages.
@@ -172,24 +254,16 @@ def paged_prefill(cfg: ModelConfig, params, k_pages, v_pages,
     tokens [1, S_pad] with S_pad a multiple of page_size; page_map
     [S_pad // page_size] int32 page ids (entries past the prompt's pages
     must be TRASH_PAGE).  ``use_flash``: see llama.prefill_kv.  Returns
-    (k_pages', v_pages', logits [1, V]).
+    (pool', logits [1, V]).
     """
     _, s_pad = tokens.shape
-    page_size = k_pages.shape[2]
+    page_size = pool.page_size
     assert s_pad % page_size == 0, (s_pad, page_size)
     new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length,
                                             use_flash)
-
-    n_seq_pages = s_pad // page_size
-
-    # [L, S_pad, n_kv, d] -> [L, n_seq_pages, page_size, n_kv*d]
-    def to_pages(a):
-        L = a.shape[0]
-        return a.reshape(L, n_seq_pages, page_size, cfg.kv_dim)
-
-    k_pages = k_pages.at[:, page_map].set(to_pages(new_k))
-    v_pages = v_pages.at[:, page_map].set(to_pages(new_v))
-    return k_pages, v_pages, logits
+    pool = _write_pool_pages(cfg, pool, new_k, new_v, page_map,
+                             s_pad // page_size, page_size)
+    return pool, logits
 
 
 def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
@@ -214,7 +288,7 @@ def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
     return out.astype(q.dtype)
 
 
-def paged_prefill_chunk(cfg: ModelConfig, params, k_pages, v_pages,
+def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, chunk_len: jnp.ndarray,
                         prefix_len: jnp.ndarray, prefix_table: jnp.ndarray,
                         page_map: jnp.ndarray):
@@ -225,18 +299,19 @@ def paged_prefill_chunk(cfg: ModelConfig, params, k_pages, v_pages,
     positions ``prefix_len + i``; prefix_table [pages_per_seq] page ids
     whose first ``prefix_len // page_size`` entries hold the cached prefix
     (later entries arbitrary — masked); page_map [C_pad // page_size] new
-    pages receiving the chunk's KV.  Returns (k_pages', v_pages',
+    pages receiving the chunk's KV.  Returns (pool',
     logits [1, V] at the last valid chunk token).
     """
     _, c_pad = tokens.shape
-    page_size = k_pages.shape[2]
+    page_size = pool.page_size
     assert c_pad % page_size == 0, (c_pad, page_size)
-    n_chunk_pages = c_pad // page_size
     s_prefix = prefix_table.shape[0] * page_size
+    dtype = jnp.dtype(cfg.dtype)
+    packed = _pool_packed(cfg, pool)
 
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = prefix_len + jnp.arange(c_pad)[None, :]          # [1, C]
-    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens).astype(dtype)
 
     # causal + validity mask in absolute positions (static shapes)
     q_pos = prefix_len + jnp.arange(c_pad)                       # [C]
@@ -251,29 +326,33 @@ def paged_prefill_chunk(cfg: ModelConfig, params, k_pages, v_pages,
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = llama._qkv(cfg, layer, h, angles, positions)
-        # gather the cached prefix: [pp, page, kv_dim] -> [1, S_pre, n_kv, d]
-        kp = k_pages[li][prefix_table].reshape(
-            1, s_prefix, cfg.n_kv_heads, cfg.head_dim)
-        vp = v_pages[li][prefix_table].reshape(
-            1, s_prefix, cfg.n_kv_heads, cfg.head_dim)
+        # gather + dequant the cached prefix: [1, S_pre, n_kv, d]
+        kp = _gather_dequant_pages(
+            pool.k[li], pool.k_scale[li] if pool.quantized else None,
+            prefix_table[None], cfg.n_kv_heads, cfg.head_dim, dtype, packed)
+        vp = _gather_dequant_pages(
+            pool.v[li], pool.v_scale[li] if pool.quantized else None,
+            prefix_table[None], cfg.n_kv_heads, cfg.head_dim, dtype, packed)
         attn = _chunk_attention(cfg, q,
                                 jnp.concatenate([kp, k], axis=1),
                                 jnp.concatenate([vp, v], axis=1), mask)
         x = x + attn.reshape(1, c_pad, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + llama._mlp(cfg, layer, hm)
-        ks.append(k[0].reshape(n_chunk_pages, page_size, cfg.kv_dim))
-        vs.append(v[0].reshape(n_chunk_pages, page_size, cfg.kv_dim))
+        ks.append(k[0])
+        vs.append(v[0])
 
-    k_pages = k_pages.at[:, page_map].set(jnp.stack(ks))
-    v_pages = v_pages.at[:, page_map].set(jnp.stack(vs))
+    pool = _write_pool_pages(
+        cfg, pool, jnp.stack(ks).reshape(cfg.n_layers, c_pad, cfg.kv_dim),
+        jnp.stack(vs).reshape(cfg.n_layers, c_pad, cfg.kv_dim),
+        page_map, c_pad // page_size, page_size)
 
     last = jax.lax.dynamic_slice_in_dim(x, chunk_len - 1, 1, axis=1)
     logits = llama._logits(cfg, params, last)[:, 0]              # [1, V]
-    return k_pages, v_pages, logits
+    return pool, logits
 
 
-def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
+def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
                       tokens: jnp.ndarray, lengths: jnp.ndarray,
                       block_tables: jnp.ndarray, *,
                       use_kernel: Optional[bool] = None):
@@ -282,43 +361,68 @@ def paged_decode_step(cfg: ModelConfig, params, k_pages, v_pages,
     tokens [B]; lengths [B] tokens already cached; block_tables
     [B, pages_per_seq].  The new token's KV is written at logical
     position lengths[b], i.e. page block_tables[b, lengths[b] // page]
-    offset lengths[b] % page.  Returns (k_pages', v_pages', logits).
+    offset lengths[b] % page.  Returns (pool', logits).
+
+    Quantized pools take the gather+dequant XLA attention path: the
+    Pallas kernel streams raw bf16 pages and has no scale-pool input
+    (extending it is future work, the layout keeps that door open).
     """
     b = tokens.shape[0]
-    page_size = k_pages.shape[2]
+    page_size = pool.page_size
+    dtype = jnp.dtype(cfg.dtype)
+    packed = _pool_packed(cfg, pool)
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = lengths[:, None]
-    x = gather_rows(params["embedding"], tokens[:, None]).astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens[:, None]).astype(dtype)
 
     page_idx = lengths // page_size
     page_ids = jnp.take_along_axis(
         block_tables, page_idx[:, None], axis=1)[:, 0]        # [B]
     offsets = lengths % page_size                             # [B]
 
-    attn_fn = paged_attention if use_kernel or (
+    attn_fn = paged_attention if not pool.quantized and (use_kernel or (
         use_kernel is None and jax.default_backend() == "tpu"
-    ) else paged_attention_xla
+    )) else paged_attention_xla
 
+    k_scale, v_scale = pool.k_scale, pool.v_scale
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = llama._qkv(cfg, layer, h, angles, positions)  # [B,1,·,d]
         # scatter this token's k/v: [B, n_kv*d] -> pool[li, page, off]
-        kp = k_pages[li].at[page_ids, offsets].set(
-            k[:, 0].reshape(b, cfg.kv_dim))
-        vp = v_pages[li].at[page_ids, offsets].set(
-            v[:, 0].reshape(b, cfg.kv_dim))
-        k_pages = k_pages.at[li].set(kp)
-        v_pages = v_pages.at[li].set(vp)
-        attn = attn_fn(q[:, 0], kp, vp, lengths + 1, block_tables)
+        k_tok = k[:, 0].reshape(b, cfg.kv_dim)
+        v_tok = v[:, 0].reshape(b, cfg.kv_dim)
+        if pool.quantized:
+            k_tok, ks = _quantize_kv(k_tok, packed)
+            v_tok, vs = _quantize_kv(v_tok, packed)
+            k_scale = k_scale.at[li].set(
+                k_scale[li].at[page_ids, offsets].set(ks))
+            v_scale = v_scale.at[li].set(
+                v_scale[li].at[page_ids, offsets].set(vs))
+        kp = pool.k[li].at[page_ids, offsets].set(k_tok)
+        vp = pool.v[li].at[page_ids, offsets].set(v_tok)
+        pool = PagePool(pool.k.at[li].set(kp), pool.v.at[li].set(vp),
+                        k_scale, v_scale)
+        if pool.quantized:
+            from k8s_llm_rca_tpu.ops.attention import decode_attention
+
+            k_all = _gather_dequant_pages(kp, k_scale[li], block_tables,
+                                          cfg.n_kv_heads, cfg.head_dim,
+                                          dtype, packed)
+            v_all = _gather_dequant_pages(vp, v_scale[li], block_tables,
+                                          cfg.n_kv_heads, cfg.head_dim,
+                                          dtype, packed)
+            attn = decode_attention(q, k_all, v_all, lengths + 1)
+        else:
+            attn = attn_fn(q[:, 0], kp, vp, lengths + 1, block_tables)
         x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + llama._mlp(cfg, layer, hm)
 
     logits = llama._logits(cfg, params, x)[:, 0]
-    return k_pages, v_pages, logits
+    return pool, logits
 
 
-def paged_decode_multi(cfg: ModelConfig, params, k_pages, v_pages,
+def paged_decode_multi(cfg: ModelConfig, params, pool: PagePool,
                        tokens: jnp.ndarray, lengths: jnp.ndarray,
                        block_tables: jnp.ndarray):
     """Multi-token paged decode (speculative verification).
@@ -328,15 +432,17 @@ def paged_decode_multi(cfg: ModelConfig, params, k_pages, v_pages,
     each slot's in-page room), so the page id is computed once per slot.
     Attention runs over the gathered page view (XLA path; T queries per
     slot don't fit the single-query Pallas kernel's grid).  Returns
-    (k_pages', v_pages', greedy [B, T], logits [B, T, V]).
+    (pool', greedy [B, T], logits [B, T, V]).
     """
     from k8s_llm_rca_tpu.ops.attention import decode_attention_multi
 
     b, t = tokens.shape
-    page_size = k_pages.shape[2]
+    page_size = pool.page_size
+    dtype = jnp.dtype(cfg.dtype)
+    packed = _pool_packed(cfg, pool)
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = lengths[:, None] + jnp.arange(t)[None, :]        # [B, T]
-    x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+    x = gather_rows(params["embedding"], tokens).astype(dtype)
 
     page_idx = lengths // page_size
     page_ids = jnp.take_along_axis(
@@ -344,30 +450,40 @@ def paged_decode_multi(cfg: ModelConfig, params, k_pages, v_pages,
     offsets = (lengths % page_size)[:, None] + jnp.arange(t)[None, :]
     pages2d = jnp.broadcast_to(page_ids, (b, t))                 # [B, T]
 
+    k_scale, v_scale = pool.k_scale, pool.v_scale
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         q, k, v = llama._qkv(cfg, layer, h, angles, positions)   # [B,T,·,d]
-        kp = k_pages[li].at[pages2d, offsets].set(
-            k.reshape(b, t, cfg.kv_dim))
-        vp = v_pages[li].at[pages2d, offsets].set(
-            v.reshape(b, t, cfg.kv_dim))
-        k_pages = k_pages.at[li].set(kp)
-        v_pages = v_pages.at[li].set(vp)
+        k_tok = k.reshape(b, t, cfg.kv_dim)
+        v_tok = v.reshape(b, t, cfg.kv_dim)
+        if pool.quantized:
+            k_tok, ks = _quantize_kv(k_tok, packed)
+            v_tok, vs = _quantize_kv(v_tok, packed)
+            k_scale = k_scale.at[li].set(
+                k_scale[li].at[pages2d, offsets].set(ks))
+            v_scale = v_scale.at[li].set(
+                v_scale[li].at[pages2d, offsets].set(vs))
+        kp = pool.k[li].at[pages2d, offsets].set(k_tok)
+        vp = pool.v[li].at[pages2d, offsets].set(v_tok)
+        pool = PagePool(pool.k.at[li].set(kp), pool.v.at[li].set(vp),
+                        k_scale, v_scale)
         # gathered dense view [B, S_max, n_kv, d] for the multi-query mask
-        k_all = jnp.take(kp, block_tables, axis=0).reshape(
-            b, -1, cfg.n_kv_heads, cfg.head_dim)
-        v_all = jnp.take(vp, block_tables, axis=0).reshape(
-            b, -1, cfg.n_kv_heads, cfg.head_dim)
+        k_all = _gather_dequant_pages(
+            kp, k_scale[li] if pool.quantized else None, block_tables,
+            cfg.n_kv_heads, cfg.head_dim, dtype, packed)
+        v_all = _gather_dequant_pages(
+            vp, v_scale[li] if pool.quantized else None, block_tables,
+            cfg.n_kv_heads, cfg.head_dim, dtype, packed)
         attn = decode_attention_multi(q, k_all, v_all, lengths + 1)
         x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + llama._mlp(cfg, layer, hm)
 
     logits = llama._logits(cfg, params, x)                       # [B, T, V]
-    return k_pages, v_pages, jnp.argmax(logits, axis=-1), logits
+    return pool, jnp.argmax(logits, axis=-1), logits
 
 
-def paged_decode_scan(cfg: ModelConfig, params, k_pages, v_pages,
+def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
                       cur_tokens: jnp.ndarray, lengths: jnp.ndarray,
                       block_tables: jnp.ndarray, key, n_steps: int,
                       sampling: SamplingParams, eos_id: int,
@@ -377,27 +493,27 @@ def paged_decode_scan(cfg: ModelConfig, params, k_pages, v_pages,
     boundary — the caller bounds ``n_steps`` by each slot's distance to
     its next boundary so ``block_tables`` stays static for the whole scan.
 
-    Returns (k_pages', v_pages', tokens [n_steps, B], lengths').  Slots
+    Returns (pool', tokens [n_steps, B], lengths').  Slots
     that hit ``eos_id`` stop advancing (token repeats; host trims)."""
 
     def body(carry, _):
-        kp, vp, cur, lens, done, key = carry
-        kp, vp, logits = paged_decode_step(cfg, params, kp, vp, cur, lens,
-                                           block_tables,
-                                           use_kernel=use_kernel)
+        pool, cur, lens, done, key = carry
+        pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
+                                         block_tables,
+                                         use_kernel=use_kernel)
         key, sub = jax.random.split(key)
         nxt = sample_tokens(logits, sub, sampling)
         newly_done = done | (nxt == eos_id)
         advance = jnp.logical_not(done)
         cur = jnp.where(advance, nxt, cur)
         lens = lens + advance.astype(lens.dtype)
-        return (kp, vp, cur, lens, newly_done, key), cur
+        return (pool, cur, lens, newly_done, key), cur
 
     done0 = jnp.zeros_like(cur_tokens, dtype=bool)
-    (k_pages, v_pages, _, lengths, _, _), toks = jax.lax.scan(
-        body, (k_pages, v_pages, cur_tokens, lengths, done0, key), None,
+    (pool, _, lengths, _, _), toks = jax.lax.scan(
+        body, (pool, cur_tokens, lengths, done0, key), None,
         length=n_steps)
-    return k_pages, v_pages, toks, lengths
+    return pool, toks, lengths
 
 
 # ---------------------------------------------------------------------------
@@ -453,8 +569,13 @@ class PagedInferenceEngine(EngineBase):
             raise ValueError(
                 f"num_pages={engine_cfg.num_pages} cannot hold one full "
                 f"sequence ({self.pages_per_seq} pages + trash page)")
-        self.k_pages, self.v_pages = init_paged_cache(
-            model_cfg, engine_cfg.num_pages, self.page_size)
+        if engine_cfg.kv_cache_dtype not in (None, "int8", "int4"):
+            raise ValueError(
+                f"unsupported kv_cache_dtype {engine_cfg.kv_cache_dtype!r} "
+                f"(None, 'int8' or 'int4')")
+        self.pool = init_paged_cache(
+            model_cfg, engine_cfg.num_pages, self.page_size,
+            kv_dtype=engine_cfg.kv_cache_dtype)
         self.allocator = make_allocator(engine_cfg.num_pages,
                                         engine_cfg.native)
         self.prefix_cache = (PrefixCache(self.allocator, self.page_size)
@@ -477,7 +598,7 @@ class PagedInferenceEngine(EngineBase):
         # donate the KV pool so XLA updates it in place — without donation
         # every tick copies the whole pool and peak HBM doubles.  (CPU has
         # no donation support and would warn on every compile, so gate it.)
-        donate = (2, 3) if jax.default_backend() == "tpu" else ()
+        donate = (2,) if jax.default_backend() == "tpu" else ()
         self._prefill = jax.jit(
             functools.partial(paged_prefill,
                               use_flash=flash_prefill_safe(params)),
@@ -488,7 +609,7 @@ class PagedInferenceEngine(EngineBase):
             paged_decode_step, static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_scan = jax.jit(
-            paged_decode_scan, static_argnums=(0, 8, 9, 10),
+            paged_decode_scan, static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_multi = jax.jit(paged_decode_multi, static_argnums=0,
                                      donate_argnums=donate)
@@ -561,8 +682,8 @@ class PagedInferenceEngine(EngineBase):
             active_slots, self.engine_cfg.max_batch,
             self.model_cfg.vocab_size)
         with METRICS.timer("engine.decode_step"):
-            self.k_pages, self.v_pages, logits = self._decode(
-                self.model_cfg, self.params, self.k_pages, self.v_pages,
+            self.pool, logits = self._decode(
+                self.model_cfg, self.params, self.pool,
                 jnp.asarray(self.cur_tokens, jnp.int32),
                 jnp.asarray(self.lengths, jnp.int32),
                 jnp.asarray(self.block_tables),
@@ -604,8 +725,8 @@ class PagedInferenceEngine(EngineBase):
         committed via the shared _verify_and_commit loop."""
         tokens_in, drafts = self._build_drafts(active_slots, self.cur_tokens)
         with METRICS.timer("engine.decode_step"):
-            self.k_pages, self.v_pages, greedy, logits = self._decode_multi(
-                self.model_cfg, self.params, self.k_pages, self.v_pages,
+            self.pool, greedy, logits = self._decode_multi(
+                self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens_in), jnp.asarray(self.lengths, jnp.int32),
                 jnp.asarray(self.block_tables))
             greedy_host = np.asarray(greedy)
@@ -633,8 +754,8 @@ class PagedInferenceEngine(EngineBase):
         accounting identical to the stepwise tick (shared commit loop)."""
         self._key, sub = jax.random.split(self._key)
         with METRICS.timer("engine.decode_step"):
-            self.k_pages, self.v_pages, toks, _ = self._decode_scan(
-                self.model_cfg, self.params, self.k_pages, self.v_pages,
+            self.pool, toks, _ = self._decode_scan(
+                self.model_cfg, self.params, self.pool,
                 jnp.asarray(self.cur_tokens, jnp.int32),
                 jnp.asarray(self.lengths, jnp.int32),
                 jnp.asarray(self.block_tables), sub, chunk, self.sampling,
@@ -709,15 +830,15 @@ class PagedInferenceEngine(EngineBase):
                     pb *= 2
                 prefix_table = np.full((pb,), TRASH_PAGE, np.int32)
                 prefix_table[:n_cp] = table[:n_cp]
-                self.k_pages, self.v_pages, logits = self._prefill_chunk(
-                    self.model_cfg, self.params, self.k_pages, self.v_pages,
+                self.pool, logits = self._prefill_chunk(
+                    self.model_cfg, self.params, self.pool,
                     jnp.asarray(padded), jnp.int32(len(rest)),
                     jnp.int32(n_cached), jnp.asarray(prefix_table),
                     jnp.asarray(table[n_cp:n_cp + n_pages]))
                 METRICS.inc("engine.prefix_hit_tokens", n_cached)
             else:
-                self.k_pages, self.v_pages, logits = self._prefill(
-                    self.model_cfg, self.params, self.k_pages, self.v_pages,
+                self.pool, logits = self._prefill(
+                    self.model_cfg, self.params, self.pool,
                     jnp.asarray(padded), jnp.int32(n),
                     jnp.asarray(table[:n_pages]))
             self._key, sub = jax.random.split(self._key)

@@ -18,6 +18,7 @@ from k8s_llm_rca_tpu.engine.paged import (
     PagedInferenceEngine, init_paged_cache, paged_decode_step, paged_prefill,
 )
 from k8s_llm_rca_tpu.engine.prefix import PrefixCache
+from k8s_llm_rca_tpu.utils.logging import METRICS
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
 
@@ -84,13 +85,13 @@ class TestPagedModelPath:
         prompt = list(range(5, 18))      # 13 tokens -> 2 pages
         ref = self._greedy_contiguous(cfg, params, prompt, 6)
 
-        k_pages, v_pages = init_paged_cache(cfg, 32, page)
+        pool = init_paged_cache(cfg, 32, page)
         # non-contiguous scattered pages on purpose
         page_map = jnp.asarray([7, 3], jnp.int32)
         padded = jnp.zeros((1, 16), jnp.int32).at[0, :13].set(
             jnp.asarray(prompt))
-        k_pages, v_pages, logits = paged_prefill(
-            cfg, params, k_pages, v_pages, padded, jnp.int32(13), page_map)
+        pool, logits = paged_prefill(
+            cfg, params, pool, padded, jnp.int32(13), page_map)
         got = [int(jnp.argmax(logits[0]))]
 
         tables = np.full((1, 8), TRASH_PAGE, np.int32)
@@ -101,8 +102,8 @@ class TestPagedModelPath:
         for _ in range(5):
             if lengths % page == 0:
                 tables[0, lengths // page] = extra.pop(0)
-            k_pages, v_pages, logits = paged_decode_step(
-                cfg, params, k_pages, v_pages,
+            pool, logits = paged_decode_step(
+                cfg, params, pool,
                 jnp.asarray([cur], jnp.int32),
                 jnp.asarray([lengths], jnp.int32),
                 jnp.asarray(tables), use_kernel=False)
@@ -423,3 +424,110 @@ class TestPagedScanTick:
             return [(r.token_ids, r.finish_reason) for r in out]
 
         assert run(1) == run(16)
+
+
+class TestQuantizedPool:
+    """int8/int4 paged KV: pool shapes, numerics vs the bf16 pool, and the
+    full engine loop (prefill, chunked prefix prefill, decode, speculative,
+    scan ticks) over a quantized pool."""
+
+    def _pools(self, cfg):
+        return {
+            "int8": init_paged_cache(cfg, 32, 8, kv_dtype=jnp.int8),
+            "int4": init_paged_cache(cfg, 32, 8, kv_dtype="int4"),
+        }
+
+    def test_pool_shapes(self):
+        cfg = TINY
+        p8 = init_paged_cache(cfg, 32, 8, kv_dtype=jnp.int8)
+        assert p8.quantized and p8.k.dtype == jnp.int8
+        assert p8.k.shape == (cfg.n_layers, 32, 8, cfg.kv_dim)
+        assert p8.k_scale.shape == (cfg.n_layers, 32, 8)
+        p4 = init_paged_cache(cfg, 32, 8, kv_dtype="int4")
+        assert p4.k.shape == (cfg.n_layers, 32, 8, cfg.kv_dim // 2)
+        assert not init_paged_cache(cfg, 32, 8).quantized
+
+    def test_quantized_decode_correlates_with_bf16(self):
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = list(range(5, 18))
+        page_map = jnp.asarray([7, 3], jnp.int32)
+        padded = jnp.zeros((1, 16), jnp.int32).at[0, :13].set(
+            jnp.asarray(prompt))
+        tables = np.full((1, 8), TRASH_PAGE, np.int32)
+        tables[0, :3] = [7, 3, 11]
+
+        def run(pool):
+            pool, logits = paged_prefill(cfg, params, pool, padded,
+                                         jnp.int32(13), page_map)
+            out = [np.asarray(logits[0])]
+            lengths, cur = 13, int(np.argmax(out[-1]))
+            for _ in range(5):
+                pool, logits = paged_decode_step(
+                    cfg, params, pool, jnp.asarray([cur], jnp.int32),
+                    jnp.asarray([lengths], jnp.int32),
+                    jnp.asarray(tables), use_kernel=False)
+                lengths += 1
+                cur = int(np.argmax(np.asarray(logits[0])))
+                out.append(np.asarray(logits[0]))
+            return np.stack(out)
+
+        ref = run(init_paged_cache(cfg, 32, 8))
+        for name, pool in self._pools(cfg).items():
+            got = run(pool)
+            assert np.isfinite(got).all()
+            corr = np.corrcoef(ref.ravel(), got.ravel())[0, 1]
+            floor = 0.99 if name == "int8" else 0.95
+            assert corr > floor, (name, corr)
+
+    def _engine(self, kv_dtype, **kw):
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        defaults = dict(max_batch=4, max_seq_len=64, page_size=8,
+                        num_pages=64, prefill_buckets=(16, 32, 64),
+                        max_new_tokens=8, temperature=0.0,
+                        kv_cache_dtype=kv_dtype)
+        defaults.update(kw)
+        tok = get_tokenizer()
+        return PagedInferenceEngine(cfg, EngineConfig(**defaults), params,
+                                    tok, use_kernel=False), tok
+
+    def test_engine_generates_and_returns_pages(self):
+        for kv_dtype in ("int8", "int4"):
+            eng, tok = self._engine(kv_dtype, prefix_cache=False)
+            res = eng.generate(
+                [tok.encode("pod oom killed", add_bos=True),
+                 tok.encode("pvc pending", add_bos=True)],
+                max_new_tokens=12)
+            assert all(r.completion_tokens == 12 for r in res), kv_dtype
+            assert eng.pool.quantized
+            eng.allocator.check()
+            assert eng.allocator.n_free == 63
+
+    def test_engine_prefix_cache_chunked_prefill(self):
+        # second submit of a shared-prefix prompt drives the quantized
+        # chunked-prefill path (gather+dequant of cached prefix pages).
+        # No exact-token assertion: the re-submit attends over the
+        # quantization-roundtripped prefix, so a greedy near-tie may
+        # legitimately flip — the mechanics (full completion, no page
+        # leaks, a recorded prefix hit) are the contract here.
+        for kv_dtype in ("int8", "int4"):
+            eng, tok = self._engine(kv_dtype, prefix_cache=True)
+            prompt = tok.encode("kubelet failed to mount volume for pod "
+                                "web-0 secret missing", add_bos=True)
+            r1 = eng.generate([list(prompt)], max_new_tokens=6)[0]
+            r2 = eng.generate([list(prompt)], max_new_tokens=6)[0]
+            assert r1.completion_tokens == 6, kv_dtype
+            assert r2.completion_tokens == 6, kv_dtype
+            assert METRICS.counters.get("engine.prefix_hit_tokens", 0) > 0
+            eng.allocator.check()
+
+    def test_engine_scan_and_speculative_ticks(self):
+        for kw in (dict(decode_chunk=8), dict(speculative_k=3)):
+            for kv_dtype in ("int8", "int4"):
+                eng, tok = self._engine(kv_dtype, prefix_cache=False, **kw)
+                r = eng.generate(
+                    [tok.encode("aaaa bbbb aaaa bbbb", add_bos=True)],
+                    max_new_tokens=12)[0]
+                assert r.completion_tokens == 12, (kw, kv_dtype)
+                eng.allocator.check()
