@@ -44,9 +44,10 @@ from k8s_llm_rca_tpu.engine.sampling import (
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.models.quant import dq, gather_rows
 from k8s_llm_rca_tpu.models.llama import _quantize_kv
+from k8s_llm_rca_tpu.ops.attention import decode_attention
 from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.paged_attention import (
-    paged_attention, paged_attention_xla,
+    paged_attention, paged_attention_quant, paged_attention_xla,
 )
 from k8s_llm_rca_tpu.engine.prefix import PrefixCache
 from k8s_llm_rca_tpu.ops.rope import rope_frequencies
@@ -363,9 +364,9 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
     position lengths[b], i.e. page block_tables[b, lengths[b] // page]
     offset lengths[b] % page.  Returns (pool', logits).
 
-    Quantized pools take the gather+dequant XLA attention path: the
-    Pallas kernel streams raw bf16 pages and has no scale-pool input
-    (extending it is future work, the layout keeps that door open).
+    Quantized pools use the quantized Pallas kernel on TPU (int8 or
+    nibble-packed int4 pages + per-token scale rows) and a gather+dequant
+    XLA path elsewhere.
     """
     b = tokens.shape[0]
     page_size = pool.page_size
@@ -380,9 +381,9 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
         block_tables, page_idx[:, None], axis=1)[:, 0]        # [B]
     offsets = lengths % page_size                             # [B]
 
-    attn_fn = paged_attention if not pool.quantized and (use_kernel or (
-        use_kernel is None and jax.default_backend() == "tpu"
-    )) else paged_attention_xla
+    kernel_on = use_kernel or (use_kernel is None
+                               and jax.default_backend() == "tpu")
+    attn_fn = paged_attention if kernel_on else paged_attention_xla
 
     k_scale, v_scale = pool.k_scale, pool.v_scale
     for li, layer in enumerate(params["layers"]):
@@ -402,9 +403,11 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
         vp = pool.v[li].at[page_ids, offsets].set(v_tok)
         pool = PagePool(pool.k.at[li].set(kp), pool.v.at[li].set(vp),
                         k_scale, v_scale)
-        if pool.quantized:
-            from k8s_llm_rca_tpu.ops.attention import decode_attention
-
+        if pool.quantized and kernel_on:
+            attn = paged_attention_quant(
+                q[:, 0], kp, vp, k_scale[li], v_scale[li], lengths + 1,
+                block_tables, packed=packed)
+        elif pool.quantized:
             k_all = _gather_dequant_pages(kp, k_scale[li], block_tables,
                                           cfg.n_kv_heads, cfg.head_dim,
                                           dtype, packed)

@@ -55,8 +55,9 @@ class QuantTensor4(NamedTuple):
     """Nibble-packed int4 weight + per-channel scale.
 
     ``q`` packs two signed 4-bit values per int8 byte along the LAST axis
-    (even logical columns in the low nibble, odd in the high nibble);
-    ``scale`` stays at the logical (unpacked) channel size."""
+    (split-half: columns [0, C/2) in the low nibbles, [C/2, C) in the
+    high — see ``_pack_nibbles``); ``scale`` stays at the logical
+    (unpacked) channel size."""
 
     q: jnp.ndarray        # int8, shape = logical shape with last dim halved
     scale: jnp.ndarray    # compute dtype, 1s except the channel axes
@@ -71,8 +72,14 @@ class QuantTensor4(NamedTuple):
 
 
 def _pack_nibbles(q: jnp.ndarray) -> jnp.ndarray:
-    """int8 values in [-8, 7], even last dim -> packed int8, last dim / 2."""
-    lo, hi = q[..., 0::2], q[..., 1::2]
+    """int8 values in [-8, 7], even last dim -> packed int8, last dim / 2.
+
+    SPLIT-HALF convention: byte i holds q[..., i] in its low nibble and
+    q[..., i + C/2] in its high nibble (NOT even/odd interleave), so
+    unpacking is a single lane-axis concat — a layout Mosaic can lower
+    inside Pallas kernels, where an element interleave cannot."""
+    half = q.shape[-1] // 2
+    lo, hi = q[..., :half], q[..., half:]
     return ((hi << 4) | (lo & 0x0F)).astype(jnp.int8)
 
 
@@ -81,8 +88,7 @@ def _unpack_nibbles(p: jnp.ndarray) -> jnp.ndarray:
     lo = jnp.bitwise_and(p, jnp.int8(0x0F))
     lo = jnp.where(lo >= 8, lo - 16, lo)            # sign-extend low nibble
     hi = jnp.right_shift(p, 4)                       # arithmetic: sign-extends
-    return jnp.stack([lo, hi], axis=-1).reshape(
-        *p.shape[:-1], p.shape[-1] * 2).astype(jnp.int8)
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.int8)
 
 
 def quantize(w: jnp.ndarray, axis=-1,

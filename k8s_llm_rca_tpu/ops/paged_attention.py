@@ -122,6 +122,102 @@ def _paged_kernel(
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
+def _paged_kernel_quant(
+    lengths_ref,        # SMEM [B]
+    tables_ref,         # SMEM [B, pages_per_seq]
+    q_ref,              # VMEM [1, n_heads, KV]  (block-diagonal expanded)
+    k_ref,              # VMEM [1, page_size, KV'] int8 (KV' = KV or KV/2)
+    v_ref,              # VMEM [1, page_size, KV'] int8
+    ks_ref,             # VMEM [8, page_size]  scale rows around this page
+    vs_ref,             # VMEM [8, page_size]
+    o_ref,              # VMEM [1, n_heads, KV]
+    acc_ref,            # VMEM scratch [n_heads, KV] f32
+    m_ref,              # VMEM scratch [n_heads, _LANES] f32
+    l_ref,              # VMEM scratch [n_heads, _LANES] f32
+    *,
+    page_size: int,
+    head_dim: int,
+    packed: bool,
+):
+    """Quantized-pool variant of ``_paged_kernel``: pages are int8 (or
+    split-half nibble-packed int4) with one scale per token.  The scales
+    never touch the [page, KV] operands — the k scale multiplies the
+    [n_heads, page] score columns and the v scale folds into the softmax
+    weights, so dequantization costs two small row broadcasts.  Scale rows
+    arrive as (8, page_size) blocks (a (1, page_size) block would violate
+    the sublane tiling rule); the row select is a one-hot contraction."""
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[bi]
+
+    @pl.when(j * page_size < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)               # [n_heads, KV]
+        n_heads = q.shape[0]
+
+        def unpack(ref):
+            raw = ref[0].astype(jnp.int32)             # [page, KV']
+            if not packed:
+                return raw.astype(jnp.float32)
+            lo = ((raw << 28) >> 28).astype(jnp.float32)   # sign-extended
+            hi = (raw >> 4).astype(jnp.float32)
+            return jnp.concatenate([lo, hi], axis=-1)  # [page, KV]
+
+        k = unpack(k_ref)
+        v = unpack(v_ref)
+
+        # select this page's scale row from the (8, page_size) block.
+        # where-then-sum, NOT multiply-by-onehot: rows past the pool's end
+        # are uninitialized block padding that may hold inf/NaN, and
+        # NaN * 0 would poison the sum
+        row = tables_ref[bi, j] % 8
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0) == row)
+        ks = jnp.sum(jnp.where(onehot, ks_ref[:, :].astype(jnp.float32),
+                               0.0), axis=0)
+        vs = jnp.sum(jnp.where(onehot, vs_ref[:, :].astype(jnp.float32),
+                               0.0), axis=0)
+
+        scale = jax.lax.rsqrt(jnp.float32(head_dim))
+        s = jax.lax.dot_general(
+            q * scale, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * ks[None, :]                                # [n_heads, page]
+
+        k_pos = (jax.lax.broadcasted_iota(jnp.int32, (n_heads, page_size), 1)
+                 + j * page_size)
+        s = jnp.where(k_pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - shift)
+        correction = jnp.exp(m_prev - shift)
+
+        l_ref[:, 0:1] = l_ref[:, 0:1] * correction + jnp.sum(
+            p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p * vs[None, :], v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [n_heads, KV]
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
 def _expand_block_diag(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:
     """[B, n_heads, d] -> [B, n_heads, n_kv*d] with row i nonzero only on
     kv-head (i // n_rep)'s d-slice."""
@@ -197,6 +293,77 @@ def paged_attention(
         lengths.astype(jnp.int32),
         block_tables.astype(jnp.int32),
         q_exp, k_pages, v_pages,
+    )
+    return _extract_block_diag(out, n_kv, d)
+
+
+@functools.partial(jax.jit, static_argnames=("packed", "interpret"))
+def paged_attention_quant(
+    q: jnp.ndarray,             # [B, n_heads, d]
+    k_pages: jnp.ndarray,       # [n_pages, page_size, KV'] int8
+    v_pages: jnp.ndarray,       # [n_pages, page_size, KV'] int8
+    k_scales: jnp.ndarray,      # [n_pages, page_size]
+    v_scales: jnp.ndarray,      # [n_pages, page_size]
+    lengths: jnp.ndarray,       # [B] int32
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    *,
+    packed: bool = False,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Decode attention over a QUANTIZED paged pool (int8, or split-half
+    nibble-packed int4 when ``packed``): [B, n_heads, d]."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, n_heads, d = q.shape
+    _, page_size, kv_store = k_pages.shape
+    kv_dim = kv_store * 2 if packed else kv_store
+    assert kv_dim % d == 0, (kv_dim, d)
+    n_kv = kv_dim // d
+    assert n_heads % n_kv == 0, (n_heads, n_kv)
+    pages_per_seq = block_tables.shape[1]
+
+    q_exp = _expand_block_diag(q, n_kv)
+    grid = (b, pages_per_seq)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel_quant, page_size=page_size,
+                          head_dim=d, packed=packed),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, n_heads, kv_dim),
+                             lambda bi, j, lens, tabs: (bi, 0, 0)),
+                pl.BlockSpec((1, page_size, kv_store),
+                             lambda bi, j, lens, tabs: (tabs[bi, j], 0, 0)),
+                pl.BlockSpec((1, page_size, kv_store),
+                             lambda bi, j, lens, tabs: (tabs[bi, j], 0, 0)),
+                # scale rows: (8, page) blocks — a (1, page) block would
+                # break the sublane tiling rule; the kernel one-hot-selects
+                # row tabs[bi, j] % 8
+                pl.BlockSpec((8, page_size),
+                             lambda bi, j, lens, tabs: (tabs[bi, j] // 8, 0)),
+                pl.BlockSpec((8, page_size),
+                             lambda bi, j, lens, tabs: (tabs[bi, j] // 8, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, n_heads, kv_dim),
+                                   lambda bi, j, lens, tabs: (bi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n_heads, kv_dim), jnp.float32),
+                pltpu.VMEM((n_heads, _LANES), jnp.float32),
+                pltpu.VMEM((n_heads, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_heads, kv_dim), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        q_exp, k_pages, v_pages, k_scales, v_scales,
     )
     return _extract_block_diag(out, n_kv, d)
 

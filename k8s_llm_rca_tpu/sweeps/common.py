@@ -33,8 +33,14 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--paged", action="store_true",
                         help="paged KV cache engine (preemption + prefix "
                              "caching) instead of contiguous slots")
-    parser.add_argument("--int8", action="store_true",
-                        help="weight-only int8 quantization")
+    quant = parser.add_mutually_exclusive_group()
+    quant.add_argument("--int8", action="store_true",
+                       help="weight-only int8 quantization")
+    quant.add_argument("--int4", action="store_true",
+                       help="weight-only int4 quantization (nibble-packed)")
+    parser.add_argument("--kv-dtype", default=None,
+                        choices=["int8", "int4"],
+                        help="quantized KV cache (default: model dtype)")
     parser.add_argument("--weights", default=None,
                         help="HF safetensors file/dir to load real weights "
                              "from (default: random init)")
@@ -65,14 +71,16 @@ def build_service(args) -> AssistantService:
         params = load_llama(model_cfg, args.weights)
     else:
         params = llama.init_params(model_cfg, jax.random.PRNGKey(0))
-    if getattr(args, "int8", False):
+    if getattr(args, "int8", False) or getattr(args, "int4", False):
         from k8s_llm_rca_tpu.models.quant import quantize_params
 
-        params = quantize_params(params)
+        params = quantize_params(
+            params, bits=4 if getattr(args, "int4", False) else 8)
     engine = make_engine(
         model_cfg,
         EngineConfig(max_batch=args.max_batch, max_seq_len=args.max_seq_len,
-                     paged=getattr(args, "paged", False)),
+                     paged=getattr(args, "paged", False),
+                     kv_cache_dtype=getattr(args, "kv_dtype", None)),
         params, tokenizer)
     return AssistantService(EngineBackend(engine))
 

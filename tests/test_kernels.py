@@ -100,3 +100,91 @@ class TestPagedAttention:
         out = paged_attention(q, kp, vp, lengths, tables)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestPagedAttentionQuant:
+    """Quantized-pool kernel vs a dense gather+dequant reference."""
+
+    def _mk_quant_pool(self, key, n_kv, n_pages, page, d, packed):
+        from k8s_llm_rca_tpu.models.llama import _quantize_kv
+
+        kk, kv = jax.random.split(key)
+        kd = jax.random.normal(kk, (n_pages, page, n_kv * d))
+        vd = jax.random.normal(kv, (n_pages, page, n_kv * d))
+        kq, ks = _quantize_kv(kd, packed)
+        vq, vs = _quantize_kv(vd, packed)
+        return kq, vq, ks, vs
+
+    def _reference(self, q, kq, vq, ks, vs, lengths, tables, packed):
+        from k8s_llm_rca_tpu.models.llama import _dequant_layer
+
+        kd = _dequant_layer(kq, ks, jnp.float32, packed)
+        vd = _dequant_layer(vq, vs, jnp.float32, packed)
+        return paged_attention_xla(q, kd, vd, lengths, tables)
+
+    @pytest.mark.parametrize("packed", [False, True])
+    @pytest.mark.parametrize("n_heads,n_kv", [(4, 4), (8, 2)])
+    def test_matches_dequant_reference(self, n_heads, n_kv, packed):
+        from k8s_llm_rca_tpu.ops.paged_attention import paged_attention_quant
+
+        b, d, page, n_pages = 3, 64, 16, 32
+        q = jax.random.normal(jax.random.PRNGKey(7), (b, n_heads, d))
+        kq, vq, ks, vs = self._mk_quant_pool(jax.random.PRNGKey(8), n_kv,
+                                             n_pages, page, d, packed)
+        # page ids straddle the (8, page) scale-block boundaries on purpose
+        tables = jnp.array([[5, 9, 2, 0],
+                            [7, 0, 0, 0],
+                            [16, 30, 11, 23]], jnp.int32)
+        lengths = jnp.array([3 * page + 5, page - 2, 4 * page], jnp.int32)
+
+        ref = self._reference(q, kq, vq, ks, vs, lengths, tables, packed)
+        out = paged_attention_quant(q, kq, vq, ks, vs, lengths, tables,
+                                    packed=packed)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("packed", [False, True])
+    def test_single_token_sequence(self, packed):
+        from k8s_llm_rca_tpu.ops.paged_attention import paged_attention_quant
+
+        b, n_heads, n_kv, d, page = 1, 4, 4, 64, 16
+        q = jax.random.normal(jax.random.PRNGKey(9), (b, n_heads, d))
+        kq, vq, ks, vs = self._mk_quant_pool(jax.random.PRNGKey(10), n_kv,
+                                             9, page, d, packed)
+        tables = jnp.zeros((1, 2), jnp.int32).at[0, 0].set(8)
+        lengths = jnp.array([1], jnp.int32)
+        ref = self._reference(q, kq, vq, ks, vs, lengths, tables, packed)
+        out = paged_attention_quant(q, kq, vq, ks, vs, lengths, tables,
+                                    packed=packed)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_engine_decode_step_uses_kernel_path(self):
+        # use_kernel=True on CPU runs the quant kernel in interpret mode;
+        # its logits must match the gather+dequant path (use_kernel=False)
+        from k8s_llm_rca_tpu.config import TINY
+        from k8s_llm_rca_tpu.engine.paged import (
+            init_paged_cache, paged_decode_step, paged_prefill,
+        )
+        from k8s_llm_rca_tpu.models import llama
+
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        for kv_dtype in (jnp.int8, "int4"):
+            pool = init_paged_cache(cfg, 32, 8, kv_dtype=kv_dtype)
+            prompt = list(range(5, 18))
+            padded = jnp.zeros((1, 16), jnp.int32).at[0, :13].set(
+                jnp.asarray(prompt))
+            pool, logits = paged_prefill(cfg, params, pool, padded,
+                                         jnp.int32(13),
+                                         jnp.asarray([7, 3], jnp.int32))
+            tables = jnp.asarray([[7, 3, 11, 0, 0, 0, 0, 0]], jnp.int32)
+            args = (jnp.asarray([int(jnp.argmax(logits[0]))], jnp.int32),
+                    jnp.asarray([13], jnp.int32), tables)
+            _, lg_kernel = paged_decode_step(cfg, params, pool, *args,
+                                             use_kernel=True)
+            _, lg_xla = paged_decode_step(cfg, params, pool, *args,
+                                          use_kernel=False)
+            np.testing.assert_allclose(np.asarray(lg_kernel),
+                                       np.asarray(lg_xla),
+                                       rtol=2e-4, atol=2e-4)
