@@ -122,14 +122,15 @@ def bench_8b():
     """Llama-3-8B int4 decode throughput on one chip (the BASELINE metric
     names tokens/sec/chip at ~7-8B scale).  Streaming quantized init keeps
     peak HBM near the int4 model size (~4.3G); the freed HBM goes to
-    nibble-packed int4 KV slots — batch 256 at seq 512 vs batch 64 at
-    int8 weights + int8 KV (3.2x measured tok/s on this chip)."""
+    nibble-packed int4 KV slots — batch 320 at seq 448 vs batch 64 at
+    int8 weights + int8 KV (~4x measured tok/s on this chip; 352 slots
+    or seq 512 at this batch tip over the HBM cliff and thrash)."""
     from k8s_llm_rca_tpu.models.quant import quantizing_transform
 
-    cfg = MODEL_REGISTRY["llama3-8b"].replace(max_seq_len=512)
+    cfg = MODEL_REGISTRY["llama3-8b"].replace(max_seq_len=448)
     params = llama.init_params(cfg, jax.random.PRNGKey(0),
                                tensor_transform=quantizing_transform(bits=4))
-    batch, prompt_len, steps = 256, 128, 192
+    batch, prompt_len, steps = 320, 64, 192
     cache = llama.init_cache(cfg, batch, cfg.max_seq_len,
                              kv_dtype="int4")
     return _timed_decode_scan(cfg, params, cache, batch, prompt_len, steps,

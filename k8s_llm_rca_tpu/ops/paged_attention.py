@@ -53,6 +53,40 @@ NEG_INF = -1e30
 _LANES = 128
 
 
+def _flash_init(acc_ref, m_ref, l_ref):
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+
+def _flash_accumulate(s, v, acc_ref, m_ref, l_ref, p_scale=None):
+    """One online-softmax accumulation over this page's scores ``s``
+    [n_heads, page] and values ``v`` [page, KV] (shared by the bf16 and
+    quantized kernels).  ``p_scale`` [page]: optional per-token value
+    scale folded into the softmax weights (quantized pools)."""
+    m_prev = m_ref[:, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - shift)
+    correction = jnp.exp(m_prev - shift)
+
+    l_ref[:, 0:1] = l_ref[:, 0:1] * correction + jnp.sum(
+        p, axis=-1, keepdims=True)
+    pv = p if p_scale is None else p * p_scale[None, :]
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        pv, v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                  # [n_heads, KV]
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+
+def _flash_finalize(o_ref, acc_ref, l_ref):
+    l = l_ref[:, 0:1]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
 def _paged_kernel(
     lengths_ref,        # SMEM [B]
     tables_ref,         # SMEM [B, pages_per_seq]  (index-map only)
@@ -74,9 +108,7 @@ def _paged_kernel(
 
     @pl.when(j == 0)
     def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
+        _flash_init(acc_ref, m_ref, l_ref)
 
     length = lengths_ref[bi]
 
@@ -99,27 +131,11 @@ def _paged_kernel(
         k_pos = (jax.lax.broadcasted_iota(jnp.int32, (n_heads, page_size), 1)
                  + j * page_size)
         s = jnp.where(k_pos < length, s, NEG_INF)
-
-        m_prev = m_ref[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - shift)
-        correction = jnp.exp(m_prev - shift)
-
-        l_ref[:, 0:1] = l_ref[:, 0:1] * correction + jnp.sum(
-            p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-            p, v,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                              # [n_heads, KV]
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        _flash_accumulate(s, v, acc_ref, m_ref, l_ref)
 
     @pl.when(j == n_pages - 1)
     def _finalize():
-        l = l_ref[:, 0:1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        _flash_finalize(o_ref, acc_ref, l_ref)
 
 
 def _paged_kernel_quant(
@@ -152,9 +168,7 @@ def _paged_kernel_quant(
 
     @pl.when(j == 0)
     def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
+        _flash_init(acc_ref, m_ref, l_ref)
 
     length = lengths_ref[bi]
 
@@ -180,10 +194,8 @@ def _paged_kernel_quant(
         # NaN * 0 would poison the sum
         row = tables_ref[bi, j] % 8
         onehot = (jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0) == row)
-        ks = jnp.sum(jnp.where(onehot, ks_ref[:, :].astype(jnp.float32),
-                               0.0), axis=0)
-        vs = jnp.sum(jnp.where(onehot, vs_ref[:, :].astype(jnp.float32),
-                               0.0), axis=0)
+        ks = jnp.sum(jnp.where(onehot, ks_ref[:, :], 0.0), axis=0)
+        vs = jnp.sum(jnp.where(onehot, vs_ref[:, :], 0.0), axis=0)
 
         scale = jax.lax.rsqrt(jnp.float32(head_dim))
         s = jax.lax.dot_general(
@@ -195,27 +207,11 @@ def _paged_kernel_quant(
         k_pos = (jax.lax.broadcasted_iota(jnp.int32, (n_heads, page_size), 1)
                  + j * page_size)
         s = jnp.where(k_pos < length, s, NEG_INF)
-
-        m_prev = m_ref[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - shift)
-        correction = jnp.exp(m_prev - shift)
-
-        l_ref[:, 0:1] = l_ref[:, 0:1] * correction + jnp.sum(
-            p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-            p * vs[None, :], v,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                              # [n_heads, KV]
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        _flash_accumulate(s, v, acc_ref, m_ref, l_ref, p_scale=vs)
 
     @pl.when(j == n_pages - 1)
     def _finalize():
-        l = l_ref[:, 0:1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        _flash_finalize(o_ref, acc_ref, l_ref)
 
 
 def _expand_block_diag(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:
@@ -363,7 +359,11 @@ def paged_attention_quant(
     )(
         lengths.astype(jnp.int32),
         block_tables.astype(jnp.int32),
-        q_exp, k_pages, v_pages, k_scales, v_scales,
+        q_exp, k_pages, v_pages,
+        # scales enter as f32 regardless of the pool's compute dtype: the
+        # (8, page_size) scale BlockSpec is validated on-chip for f32
+        # sublane tiling, and the cast is O(n_pages * page_size) — noise
+        k_scales.astype(jnp.float32), v_scales.astype(jnp.float32),
     )
     return _extract_block_diag(out, n_kv, d)
 

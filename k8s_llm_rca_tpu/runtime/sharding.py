@@ -105,14 +105,31 @@ def kv_cache_specs() -> Any:
 
 def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
     """Device-put a pytree with NamedShardings built from a spec pytree.
+
     ``None`` leaves (optional fields, e.g. KVCache scale arrays of a
-    full-precision cache) pass through unsharded."""
+    full-precision cache) pass through unsharded.  Quantized weights
+    (``QuantTensor``/``QuantTensor4``) are treated as single leaves whose
+    spec is the underlying weight's: the int payload takes it verbatim and
+    the per-channel scale takes it with every size-1 (reduced) dim
+    replicated — so TP composes with int8/int4 params.
+    """
+    from k8s_llm_rca_tpu.models.quant import QuantTensor, QuantTensor4
+
     def _put(x, spec):
         if x is None:
             return None
+        if isinstance(x, (QuantTensor, QuantTensor4)):
+            scale_spec = P(*(s if dim > 1 else None
+                             for s, dim in zip(spec, x.scale.shape)))
+            return type(x)(
+                q=jax.device_put(x.q, NamedSharding(mesh, spec)),
+                scale=jax.device_put(x.scale, NamedSharding(mesh, scale_spec)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
-    return jax.tree.map(_put, tree, specs, is_leaf=lambda x: x is None)
+    return jax.tree.map(
+        _put, tree, specs,
+        is_leaf=lambda x: x is None or isinstance(x, (QuantTensor,
+                                                      QuantTensor4)))
 
 
 def constrain(x, mesh: Mesh, spec: P):

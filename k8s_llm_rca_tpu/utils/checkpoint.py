@@ -13,6 +13,12 @@ serve/api.py's state store).  This module adds the weight/optimizer side:
   retention, built on ``orbax.checkpoint.CheckpointManager``; restore
   targets an abstract pytree so arrays come back with the intended
   shardings under a mesh.
+
+Format note: int4-quantized trees (``QuantTensor4``) store nibble-PACKED
+bytes whose layout is defined by ``models.quant._pack_nibbles`` (split-half
+convention).  A checkpoint of packed weights is only readable by a build
+using the same packing; when in doubt, checkpoint the full-precision tree
+and quantize after restore.
 """
 
 from __future__ import annotations

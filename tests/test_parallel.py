@@ -240,6 +240,38 @@ def test_tp_sharded_engine_matches_unsharded(cpu_devices):
         assert r.token_ids == g.token_ids
 
 
+def test_tp_sharded_engine_quantized_params(cpu_devices):
+    """TP x quantization: sharding int8/int4 params must work (the int
+    payload takes the weight spec, per-channel scales replicate their
+    reduced dims) and the sharded engine must emit the unsharded engine's
+    greedy tokens."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.models.quant import quantize_params
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True)]
+
+    for bits in (8, 4):
+        qp = quantize_params(llama.init_params(cfg, jax.random.PRNGKey(0)),
+                             compute_dtype=jnp.float32, bits=bits)
+        ref = make_engine(cfg, ecfg, qp, tok).generate(
+            prompts, max_new_tokens=6)
+        sharded = shard_pytree(qp, llama_param_specs(cfg), mesh)
+        got = make_engine(cfg, ecfg, sharded, tok).generate(
+            prompts, max_new_tokens=6)
+        assert ref[0].token_ids == got[0].token_ids, bits
+
+
 def test_cp_prefill_matches_single_device(seq_mesh):
     """Ring-attention (context-parallel) prefill must produce the same KV
     and last-token logits as the plain single-device prefill."""
