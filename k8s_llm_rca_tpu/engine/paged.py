@@ -289,6 +289,32 @@ def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
     return out.astype(q.dtype)
 
 
+def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
+                        tokens: jnp.ndarray, lengths: jnp.ndarray,
+                        page_maps: jnp.ndarray, use_flash: bool = False):
+    """Prefill N sequences into their pool pages in ONE dispatch.
+
+    tokens [N, S_pad] right-padded (S_pad a page multiple); lengths [N];
+    page_maps [N, S_pad // page_size] int32 page ids — DISTINCT across
+    rows except padding rows repeating the last real row (idempotent
+    duplicate writes, same contract as llama.prefill_batch slots).
+    Returns (pool', logits [N, V] at each row's last valid token).
+    """
+    n, s_pad = tokens.shape
+    page_size = pool.page_size
+    assert s_pad % page_size == 0, (s_pad, page_size)
+    n_seq_pages = s_pad // page_size
+    new_k, new_v, logits = llama._prefill_batch_kv(cfg, params, tokens,
+                                                   lengths, use_flash)
+    # fold the batch dim into the page dim: the single-sequence write
+    # helper scatters [L, total_pages, page, kv] by a flat page map
+    pool = _write_pool_pages(
+        cfg, pool, new_k.reshape(cfg.n_layers, n * s_pad, cfg.kv_dim),
+        new_v.reshape(cfg.n_layers, n * s_pad, cfg.kv_dim),
+        page_maps.reshape(-1), n * n_seq_pages, page_size)
+    return pool, logits
+
+
 def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, chunk_len: jnp.ndarray,
                         prefix_len: jnp.ndarray, prefix_table: jnp.ndarray,
@@ -606,6 +632,10 @@ class PagedInferenceEngine(EngineBase):
             functools.partial(paged_prefill,
                               use_flash=flash_prefill_safe(params)),
             static_argnums=0, donate_argnums=donate)
+        self._prefill_batch = jax.jit(
+            functools.partial(paged_prefill_batch,
+                              use_flash=flash_prefill_safe(params)),
+            static_argnums=0, donate_argnums=donate)
         self._prefill_chunk = jax.jit(paged_prefill_chunk, static_argnums=0,
                                       donate_argnums=donate)
         self._decode = jax.jit(
@@ -637,9 +667,13 @@ class PagedInferenceEngine(EngineBase):
     def step(self) -> List[SequenceResult]:
         finished: List[SequenceResult] = []
         while self._pending and self._free_slots:
-            pend = self._pending[0]
+            group, matched = self._admission_group()
             try:
-                early = self._admit(pend)
+                if len(group) == 1:
+                    early = self._admit(group[0], matched)
+                    admitted = [early] if early is not None else []
+                else:
+                    admitted = self._admit_batch(group)
             except OutOfPages:
                 # Admission never preempts: evicting a running sequence to
                 # admit a queued one just swaps which request waits while
@@ -648,9 +682,8 @@ class PagedInferenceEngine(EngineBase):
                 # pages; only the growth path below preempts, because a
                 # sequence that cannot grow cannot make progress at all.
                 break
-            self._pending.pop(0)
-            if early is not None:
-                finished.append(early)
+            del self._pending[:len(group)]
+            finished.extend(admitted)
         if not self._active:
             return finished
 
@@ -793,12 +826,44 @@ class PagedInferenceEngine(EngineBase):
                 raise
             return self.allocator.alloc(n, owner=owner)
 
-    def _admit(self, req: _Pending) -> Optional[SequenceResult]:
-        n = len(req.prompt_ids)
-        cached_pages: List[int] = []
-        n_cached = 0
+    def _admission_group(self) -> Tuple[List[_Pending], Tuple[List[int], int]]:
+        """Peek (without popping) a FIFO run of same-bucket pending
+        requests for one batched prefill, plus the head's prefix-cache
+        match (acquired here so admission doesn't match twice).  A head
+        WITH a cached prefix admits singly through the chunked path.
+        Later group members skip their own match — their potential hit is
+        forgone, but insert() after the batched prefill still chains their
+        pages for future requests."""
+        head = self._pending[0]
+        matched: Tuple[List[int], int] = ([], 0)
         if self.prefix_cache is not None:
-            cached_pages, n_cached = self.prefix_cache.match(req.prompt_ids)
+            matched = self.prefix_cache.match(head.prompt_ids)
+        if matched[1]:
+            return [head], matched
+        group = [head]
+        b0 = self._bucket(len(head.prompt_ids))
+        # bound the group so every member's pages fit the CURRENT free
+        # list: _admit_batch's allocation is all-or-nothing, and a group
+        # sized past the pool would fail forever where admitting the head
+        # alone (which can also evict prefix pages) makes progress
+        n_pages = max(1, b0 // self.page_size)
+        cap = min(8, len(self._free_slots),
+                  max(1, self.allocator.n_free // n_pages))
+        for req in itertools.islice(self._pending, 1, None):
+            if (len(group) >= cap
+                    or self._bucket(len(req.prompt_ids)) != b0):
+                break
+            group.append(req)
+        return group, matched
+
+    def _admit(self, req: _Pending,
+               matched: Optional[Tuple[List[int], int]] = None
+               ) -> Optional[SequenceResult]:
+        n = len(req.prompt_ids)
+        if matched is None:
+            matched = (self.prefix_cache.match(req.prompt_ids)
+                       if self.prefix_cache is not None else ([], 0))
+        cached_pages, n_cached = matched
         n_cp = len(cached_pages)
         rest = req.prompt_ids[n_cached:]
         # cap the bucket at the table space left after the cached prefix
@@ -848,6 +913,16 @@ class PagedInferenceEngine(EngineBase):
             first = self._sample(logits, sub, self.sampling)
         METRICS.inc("engine.prefill_tokens", len(rest))
 
+        return self._activate_paged(req, slot, table, n_cp, logits,
+                                    int(first[0]))
+
+    def _activate_paged(self, req: _Pending, slot: int, table, n_cp: int,
+                        logits_1v, first_token: int
+                        ) -> Optional[SequenceResult]:
+        """Shared post-prefill bookkeeping (single and batched admission):
+        chain pages into the prefix cache, grammar-constrain the first
+        token, register the slot, early-retire if already terminal."""
+        n = len(req.prompt_ids)
         n_shared = n_cp
         if self.prefix_cache is not None:
             n_shared = self.prefix_cache.insert(req.prompt_ids, table,
@@ -856,11 +931,11 @@ class PagedInferenceEngine(EngineBase):
                      max_new_tokens=req.max_new_tokens,
                      stop_strings=req.stop_strings, grammar=req.grammar,
                      n_shared=n_shared)
-        token = int(first[0])
+        token = first_token
         if st.grammar is not None:
             remaining = min(st.max_new_tokens,
                             self.engine_cfg.max_seq_len - n - 1)
-            token = self._grammar_first_token(st.grammar, logits, token,
+            token = self._grammar_first_token(st.grammar, logits_1v, token,
                                               remaining)
             st.grammar.advance(token)
         st.generated.append(token)
@@ -871,6 +946,64 @@ class PagedInferenceEngine(EngineBase):
         if reason is not None:
             return self._retire(slot, reason)
         return None
+
+    def _admit_batch(self, reqs: List[_Pending]) -> List[SequenceResult]:
+        """Admit N same-bucket prefix-miss sequences with ONE batched
+        paged prefill (pads to a power of two by repeating the last real
+        row's tokens AND pages — the duplicate scatter writes are
+        idempotent, same contract as llama.prefill_batch slots)."""
+        n = len(reqs)
+        bucket = min(self._bucket(max(len(r.prompt_ids) for r in reqs)),
+                     self.pages_per_seq * self.page_size)
+        n_pages = bucket // self.page_size
+        allocated: List[List[int]] = []
+        try:
+            for r in reqs:
+                allocated.append(
+                    self._alloc_with_evict(n_pages, owner=r.seq_id))
+        except OutOfPages:
+            for r, pages in zip(reqs, allocated):
+                self.allocator.free(pages, owner=r.seq_id)
+            raise
+        slots = [self._free_slots.pop(0) for _ in range(n)]
+
+        n_pad = 1
+        while n_pad < n:
+            n_pad *= 2
+        tokens = np.zeros((n_pad, bucket), np.int32)
+        lens = np.zeros((n_pad,), np.int32)
+        maps = np.zeros((n_pad, n_pages), np.int32)
+        tables = []
+        for i, r in enumerate(reqs):
+            tokens[i, :len(r.prompt_ids)] = r.prompt_ids
+            lens[i] = len(r.prompt_ids)
+            maps[i] = allocated[i]
+            table = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
+            table[:n_pages] = allocated[i]
+            self.block_tables[slots[i]] = table
+            tables.append(table)
+        tokens[n:] = tokens[n - 1]
+        lens[n:] = lens[n - 1]
+        maps[n:] = maps[n - 1]
+
+        with METRICS.timer("engine.prefill"):
+            self.pool, logits = self._prefill_batch(
+                self.model_cfg, self.params, self.pool,
+                jnp.asarray(tokens), jnp.asarray(lens), jnp.asarray(maps))
+            self._key, sub = jax.random.split(self._key)
+            firsts = self._sample(logits, sub, self.sampling)
+        METRICS.inc("engine.prefill_tokens", int(lens[:n].sum()))
+        METRICS.inc("engine.batched_admissions", n)
+
+        finished: List[SequenceResult] = []
+        firsts_host = np.asarray(firsts)
+        for i, req in enumerate(reqs):
+            early = self._activate_paged(req, slots[i], tables[i], 0,
+                                         logits[i:i + 1],
+                                         int(firsts_host[i]))
+            if early is not None:
+                finished.append(early)
+        return finished
 
     def _grow(self, slot: int) -> None:
         st = self._active[slot]

@@ -618,19 +618,13 @@ def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 
-def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
-                  tokens: jnp.ndarray, lengths: jnp.ndarray,
-                  slots: jnp.ndarray, use_flash: bool = False
-                  ) -> Tuple[KVCache, jnp.ndarray]:
-    """Prefill N sequences into their cache slots in ONE dispatch.
-
-    tokens [N, S_pad] right-padded; lengths [N]; slots [N] DISTINCT slot
-    ids (duplicates are allowed only for identical rows — the admission
-    batcher pads a partial batch by repeating its last real row, making
-    the duplicate scatter writes idempotent).  Returns (cache', logits
-    [N, V] at each row's last valid token).  One compile per (N, S_pad)
-    bucket pair; the engine buckets both.
-    """
+def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
+                      lengths: jnp.ndarray, use_flash: bool = False
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched prefill forward WITHOUT a cache write: tokens [N, S_pad]
+    right-padded, lengths [N] -> (new_k [L, N, S_pad, kv_dim], new_v,
+    logits [N, V] at each row's last valid token).  Shared by the
+    contiguous (slot-scatter) and paged (page-scatter) admission paths."""
     n, s_pad = tokens.shape
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s_pad)[None, :], (n, s_pad))
@@ -650,8 +644,28 @@ def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
         ks.append(k.reshape(n, s_pad, cfg.kv_dim))   # [N, S_pad, kv]
         vs.append(v.reshape(n, s_pad, cfg.kv_dim))
 
-    new_k = jnp.stack(ks)                            # [L, N, S_pad, kv]
-    new_v = jnp.stack(vs)
+    idx = jnp.arange(n)
+    last = x[idx, lengths - 1][:, None]              # [N, 1, H]
+    logits = _logits(cfg, params, last)[:, 0]        # [N, V]
+    return jnp.stack(ks), jnp.stack(vs), logits      # [L, N, S_pad, kv]
+
+
+def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
+                  tokens: jnp.ndarray, lengths: jnp.ndarray,
+                  slots: jnp.ndarray, use_flash: bool = False
+                  ) -> Tuple[KVCache, jnp.ndarray]:
+    """Prefill N sequences into their cache slots in ONE dispatch.
+
+    tokens [N, S_pad] right-padded; lengths [N]; slots [N] DISTINCT slot
+    ids (duplicates are allowed only for identical rows — the admission
+    batcher pads a partial batch by repeating its last real row, making
+    the duplicate scatter writes idempotent).  Returns (cache', logits
+    [N, V] at each row's last valid token).  One compile per (N, S_pad)
+    bucket pair; the engine buckets both.
+    """
+    _, s_pad = tokens.shape
+    new_k, new_v, logits = _prefill_batch_kv(cfg, params, tokens, lengths,
+                                             use_flash)
     if cache.quantized:
         packed = _kv_packed(cfg, cache)
         new_k, k_s = _quantize_kv(new_k, packed)     # scales [L, N, S_pad]
@@ -662,8 +676,4 @@ def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
         k_scale, v_scale = cache.k_scale, cache.v_scale
     k_cache = cache.k.at[:, slots, :s_pad].set(new_k)
     v_cache = cache.v.at[:, slots, :s_pad].set(new_v)
-
-    idx = jnp.arange(n)
-    last = x[idx, lengths - 1][:, None]              # [N, 1, H]
-    logits = _logits(cfg, params, last)[:, 0]        # [N, V]
     return KVCache(k_cache, v_cache, k_scale, v_scale), logits

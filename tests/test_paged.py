@@ -531,3 +531,71 @@ class TestQuantizedPool:
                     max_new_tokens=12)[0]
                 assert r.completion_tokens == 12, (kw, kv_dtype)
                 eng.allocator.check()
+
+
+class TestPagedBatchedAdmission:
+    def _mk(self, **kw):
+        cfg = TINY.replace(max_seq_len=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        defaults = dict(max_batch=8, max_seq_len=64, page_size=8,
+                        num_pages=64, prefill_buckets=(16, 32, 64),
+                        max_new_tokens=6, temperature=0.0,
+                        prefix_cache=False)
+        defaults.update(kw)
+        tok = get_tokenizer()
+        return (PagedInferenceEngine(cfg, EngineConfig(**defaults), params,
+                                     tok, use_kernel=False), tok)
+
+    def test_batched_admission_matches_serial(self):
+        # same-bucket prompts admit in one dispatch and must emit exactly
+        # the tokens the serial (max_batch=1 -> singleton groups) run does
+        texts = ["pod crashloop", "node notready", "pvc pending why",
+                 "dns resolution fails"]
+        eng, tok = self._mk()
+        prompts = [tok.encode(t, add_bos=True) for t in texts]
+        before = METRICS.counters.get("engine.batched_admissions", 0)
+        batched = eng.generate([list(p) for p in prompts], max_new_tokens=6)
+        # at least the same-bucket run batches (the odd-bucket prompt may
+        # admit singly)
+        assert METRICS.counters.get("engine.batched_admissions", 0) \
+            >= before + 3
+        eng.allocator.check()
+        assert eng.allocator.n_free == 63
+
+        serial, tok2 = self._mk(max_batch=1)
+        for p, rb in zip(prompts, batched):
+            rs = serial.generate([list(p)], max_new_tokens=6)[0]
+            assert rs.token_ids == rb.token_ids
+
+    def test_batched_admission_under_page_pressure(self):
+        # regression: a group sized past the free list must not wedge the
+        # engine (all-or-nothing batch alloc raising OutOfPages forever);
+        # the admission group is bounded by free pages so the head admits
+        eng, tok = self._mk(num_pages=9, max_batch=4, max_new_tokens=8)
+        prompts = [tok.encode("incident %d pod oom" % i, add_bos=True)
+                   for i in range(4)]
+        res = eng.generate([list(p) for p in prompts], max_new_tokens=8)
+        assert len(res) == 4
+        eng.allocator.check()
+        assert eng.allocator.n_free == 8
+
+    def test_batched_admission_quantized_pool(self):
+        for kv_dtype in ("int8", "int4"):
+            eng, tok = self._mk(kv_cache_dtype=kv_dtype)
+            prompts = [tok.encode(t, add_bos=True)
+                       for t in ["pod oom", "pvc lost", "node gone"]]
+            res = eng.generate([list(p) for p in prompts], max_new_tokens=6)
+            assert all(r.completion_tokens == 6 for r in res), kv_dtype
+            eng.allocator.check()
+
+    def test_prefix_hit_still_takes_chunk_path(self):
+        # head with a cached prefix must admit singly (chunked prefill),
+        # not lose its hit to a batch
+        eng, tok = self._mk(prefix_cache=True)
+        prompt = tok.encode("kubelet failed to mount volume for pod web-0",
+                           add_bos=True)
+        eng.generate([list(prompt)], max_new_tokens=4)
+        before = METRICS.counters.get("engine.prefix_hit_tokens", 0)
+        eng.generate([list(prompt)], max_new_tokens=4)
+        assert METRICS.counters.get("engine.prefix_hit_tokens", 0) > before
+        eng.allocator.check()
