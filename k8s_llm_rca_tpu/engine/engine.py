@@ -59,6 +59,17 @@ def flash_prefill_safe(params) -> bool:
     return True
 
 
+def validate_cp_divisibility(cp_seq_axis: str, n_cp: int, sizes) -> None:
+    """CP prefill shards the padded sequence over the mesh axis; every
+    prefill bucket (and max_seq_len — paged callers pass page-rounded
+    sizes) must split evenly across it.  Shared by both engines."""
+    bad = [s for s in sizes if s % n_cp]
+    if bad:
+        raise ValueError(
+            f"cp mesh axis '{cp_seq_axis}' size {n_cp} must divide "
+            f"every prefill bucket and max_seq_len; offending sizes: {bad}")
+
+
 @dataclass
 class SequenceResult:
     seq_id: int
@@ -447,14 +458,10 @@ class InferenceEngine(EngineBase):
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
         if cp_mesh is not None:
-            n_cp = cp_mesh.shape[cp_seq_axis]
-            bad = [s for s in tuple(engine_cfg.prefill_buckets)
-                   + (engine_cfg.max_seq_len,) if s % n_cp]
-            if bad:
-                raise ValueError(
-                    f"cp mesh axis '{cp_seq_axis}' size {n_cp} must divide "
-                    f"every prefill bucket and max_seq_len; offending "
-                    f"sizes: {bad}")
+            validate_cp_divisibility(
+                cp_seq_axis, cp_mesh.shape[cp_seq_axis],
+                tuple(engine_cfg.prefill_buckets)
+                + (engine_cfg.max_seq_len,))
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params

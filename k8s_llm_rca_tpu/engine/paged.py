@@ -37,6 +37,7 @@ import numpy as np
 from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
 from k8s_llm_rca_tpu.engine.engine import (
     EngineBase, SequenceResult, _Active, _Pending, flash_prefill_safe,
+    validate_cp_divisibility,
 )
 from k8s_llm_rca_tpu.engine.sampling import (
     SamplingParams, sample_tokens, sample_tokens_masked,
@@ -315,6 +316,25 @@ def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
     return pool, logits
 
 
+def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
+                     tokens: jnp.ndarray, length: jnp.ndarray,
+                     page_map: jnp.ndarray, mesh, seq_axis: str = "seq",
+                     cp_mode: str = "ring"):
+    """Context-parallel paged prefill: ring/Ulysses attention compute
+    (llama.prefill_kv_cp, sequence sharded over ``mesh[seq_axis]``) with
+    the page-scatter write — long prompts prefill across the ICI ring
+    straight into pool pages (SURVEY §7 hard-part 6: CP correctness
+    against the paged cache).  Same contract as ``paged_prefill``."""
+    _, s_pad = tokens.shape
+    page_size = pool.page_size
+    assert s_pad % page_size == 0, (s_pad, page_size)
+    new_k, new_v, logits = llama.prefill_kv_cp(cfg, params, tokens, length,
+                                               mesh, seq_axis, cp_mode)
+    pool = _write_pool_pages(cfg, pool, new_k, new_v, page_map,
+                             s_pad // page_size, page_size)
+    return pool, logits
+
+
 def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, chunk_len: jnp.ndarray,
                         prefix_len: jnp.ndarray, prefix_table: jnp.ndarray,
@@ -569,7 +589,30 @@ class PagedInferenceEngine(EngineBase):
 
     def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
                  params, tokenizer: Tokenizer,
-                 use_kernel: Optional[bool] = None):
+                 use_kernel: Optional[bool] = None,
+                 cp_mesh=None, cp_seq_axis: str = "seq",
+                 cp_mode: str = "ring"):
+        """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
+        runs context-parallel over it (ring or Ulysses, as in the
+        contiguous engine) and scatters the full-depth KV into pool pages.
+        Requires page-rounded buckets divisible by the axis size, disables
+        batched admission (prefill_kv_cp is per-sequence) and is mutually
+        exclusive with the prefix cache (the chunked prefix prefill is not
+        context-parallel)."""
+        if cp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown cp_mode {cp_mode!r}")
+        if cp_mesh is not None:
+            if engine_cfg.prefix_cache:
+                raise ValueError(
+                    "cp_mesh requires prefix_cache=False (the chunked "
+                    "prefix prefill path is not context-parallel)")
+            page = engine_cfg.page_size
+            validate_cp_divisibility(
+                cp_seq_axis, cp_mesh.shape[cp_seq_axis],
+                [-(-s // page) * page           # page-rounded, as _bucket does
+                 for s in tuple(engine_cfg.prefill_buckets)
+                 + (engine_cfg.max_seq_len,)])
+        self._batch_admission = cp_mesh is None
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params
@@ -628,10 +671,19 @@ class PagedInferenceEngine(EngineBase):
         # every tick copies the whole pool and peak HBM doubles.  (CPU has
         # no donation support and would warn on every compile, so gate it.)
         donate = (2,) if jax.default_backend() == "tpu" else ()
-        self._prefill = jax.jit(
-            functools.partial(paged_prefill,
-                              use_flash=flash_prefill_safe(params)),
-            static_argnums=0, donate_argnums=donate)
+        if cp_mesh is not None:
+            def _prefill_cp(cfg, params, pool, toks, n, page_map):
+                return paged_prefill_cp(cfg, params, pool, toks, n,
+                                        page_map, cp_mesh, cp_seq_axis,
+                                        cp_mode)
+
+            self._prefill = jax.jit(_prefill_cp, static_argnums=0,
+                                    donate_argnums=donate)
+        else:
+            self._prefill = jax.jit(
+                functools.partial(paged_prefill,
+                                  use_flash=flash_prefill_safe(params)),
+                static_argnums=0, donate_argnums=donate)
         self._prefill_batch = jax.jit(
             functools.partial(paged_prefill_batch,
                               use_flash=flash_prefill_safe(params)),
@@ -838,7 +890,7 @@ class PagedInferenceEngine(EngineBase):
         matched: Tuple[List[int], int] = ([], 0)
         if self.prefix_cache is not None:
             matched = self.prefix_cache.match(head.prompt_ids)
-        if matched[1]:
+        if matched[1] or not self._batch_admission:
             return [head], matched
         group = [head]
         b0 = self._bucket(len(head.prompt_ids))

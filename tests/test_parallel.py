@@ -358,6 +358,56 @@ def test_engine_ulysses_prefill_matches_plain_engine(seq_mesh):
     assert ref[0].token_ids == got[0].token_ids
 
 
+def test_paged_engine_cp_prefill_matches_plain_engine(seq_mesh):
+    """PagedInferenceEngine in context-parallel prefill mode emits the
+    same greedy tokens as the plain paged engine (ring and ulysses)."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=32, prefill_buckets=(16, 32, 64),
+                        max_new_tokens=6, temperature=0.0,
+                        prefix_cache=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod sandbox changed restarting", add_bos=True),
+               tok.encode("oom killed container", add_bos=True)]
+
+    ref = PagedInferenceEngine(cfg, ecfg, params, tok,
+                               use_kernel=False).generate(
+        prompts, max_new_tokens=6)
+    for mode in ("ring", "ulysses"):
+        eng = PagedInferenceEngine(cfg, ecfg, params, tok, use_kernel=False,
+                                   cp_mesh=seq_mesh, cp_mode=mode)
+        got = eng.generate([list(p) for p in prompts], max_new_tokens=6)
+        for r, g in zip(ref, got):
+            assert r.token_ids == g.token_ids, mode
+        eng.allocator.check()
+
+
+def test_paged_engine_cp_rejects_bad_configs(seq_mesh):
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        PagedInferenceEngine(
+            cfg, EngineConfig(max_batch=1, max_seq_len=64, page_size=8,
+                              num_pages=32, prefix_cache=True),
+            params, tok, cp_mesh=seq_mesh)
+    with pytest.raises(ValueError, match="must divide"):
+        PagedInferenceEngine(
+            cfg, EngineConfig(max_batch=1, max_seq_len=64, page_size=6,
+                              num_pages=32, prefill_buckets=(18,),
+                              prefix_cache=False),
+            params, tok, cp_mesh=seq_mesh)
+
+
 def test_ep_sharded_engine_matches_unsharded(cpu_devices):
     """EP serving: MoE engine fed expert-sharded params must emit the same
     greedy tokens as the unsharded engine (GSPMD partitions the dense
