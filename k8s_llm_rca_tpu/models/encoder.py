@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from k8s_llm_rca_tpu.config import EncoderConfig
+from k8s_llm_rca_tpu.models.quant import dq, gather_rows
 from k8s_llm_rca_tpu.ops.norms import layer_norm
 
 Params = Dict[str, Any]
@@ -85,16 +86,16 @@ def _self_attention(cfg: EncoderConfig, layer: Params, x: jnp.ndarray,
     b, s, h = x.shape
     nh = cfg.n_heads
     d = h // nh
-    q = (x @ layer["wq"] + layer["bq"]).reshape(b, s, nh, d)
-    k = (x @ layer["wk"] + layer["bk"]).reshape(b, s, nh, d)
-    v = (x @ layer["wv"] + layer["bv"]).reshape(b, s, nh, d)
+    q = (x @ dq(layer["wq"]) + layer["bq"]).reshape(b, s, nh, d)
+    k = (x @ dq(layer["wk"]) + layer["bk"]).reshape(b, s, nh, d)
+    v = (x @ dq(layer["wv"]) + layer["bv"]).reshape(b, s, nh, d)
 
     logits = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
     logits = logits / math.sqrt(d)
     logits = jnp.where(pad_mask[:, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
     out = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(b, s, h)
-    return out @ layer["wo"] + layer["bo"]
+    return out @ dq(layer["wo"]) + layer["bo"]
 
 
 def forward(cfg: EncoderConfig, params: Params, tokens: jnp.ndarray,
@@ -110,9 +111,9 @@ def forward(cfg: EncoderConfig, params: Params, tokens: jnp.ndarray,
     pad_mask = jnp.arange(s)[None, :] < lengths[:, None]        # [B,S]
     dtype = jnp.dtype(cfg.dtype)
 
-    x = (params["word_embedding"][tokens]
-         + params["position_embedding"][None, :s]
-         + params["type_embedding"][0][None, None]).astype(dtype)
+    x = (gather_rows(params["word_embedding"], tokens)
+         + dq(params["position_embedding"])[None, :s]
+         + dq(params["type_embedding"])[0][None, None]).astype(dtype)
     x = layer_norm(x, params["embed_ln_w"], params["embed_ln_b"],
                    cfg.layer_norm_eps)
 
@@ -120,8 +121,8 @@ def forward(cfg: EncoderConfig, params: Params, tokens: jnp.ndarray,
         attn = _self_attention(cfg, layer, x, pad_mask)
         x = layer_norm(x + attn, layer["attn_ln_w"], layer["attn_ln_b"],
                        cfg.layer_norm_eps)
-        ffn = jax.nn.gelu(x @ layer["w_in"] + layer["b_in"])
-        ffn = ffn @ layer["w_out"] + layer["b_out"]
+        ffn = jax.nn.gelu(x @ dq(layer["w_in"]) + layer["b_in"])
+        ffn = ffn @ dq(layer["w_out"]) + layer["b_out"]
         x = layer_norm(x + ffn, layer["mlp_ln_w"], layer["mlp_ln_b"],
                        cfg.layer_norm_eps)
     return x

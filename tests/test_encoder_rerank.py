@@ -137,3 +137,20 @@ def test_pipeline_rerank_integration():
         scores = analysis.get("rerank_scores")
         if scores is not None:
             assert scores == sorted(scores, reverse=True)
+
+
+def test_quantized_encoder_embeddings_correlate(enc_setup):
+    # the encoder consumes weights through dq/gather_rows, so int8/int4
+    # quantized params run the same code; pooled embeddings must stay
+    # close to full precision (cosine similarity per row)
+    from k8s_llm_rca_tpu.models.quant import quantize_params
+
+    cfg, params = enc_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (3, 12), 0,
+                                cfg.vocab_size)
+    ref = np.asarray(encoder.embed(cfg, params, tokens))
+    for bits, floor in ((8, 0.999), (4, 0.98)):
+        qp = quantize_params(params, compute_dtype=jnp.float32, bits=bits)
+        got = np.asarray(encoder.embed(cfg, qp, tokens))
+        cos = np.sum(ref * got, axis=-1)     # both unit-norm
+        assert np.all(cos > floor), (bits, cos)
