@@ -175,8 +175,10 @@ class TestPagedSpeculative:
 
 def test_feature_matrix_greedy_equivalence():
     """Crown invariant: greedy output is identical across EVERY engine
-    feature combination — speculation x chunked scan x prefix cache, with
-    a mixed workload of grammar-constrained and plain runs."""
+    feature combination — speculation x chunked scan x prefix cache x KV
+    dtype, with a mixed workload of grammar-constrained and plain runs.
+    Quantized KV legitimately shifts logits, so each KV dtype has its OWN
+    baseline; within a dtype every feature combination must agree."""
     import json as jsonlib
 
     from k8s_llm_rca_tpu.config import EngineConfig
@@ -190,13 +192,13 @@ def test_feature_matrix_greedy_equivalence():
                      tok.encode("mount failed mount failed", add_bos=True)]
     json_prompt = tok.encode("emit json", add_bos=True)
 
-    def run(spec_k, chunk, prefix):
+    def run(spec_k, chunk, prefix, kv=None):
         eng = PagedInferenceEngine(
             cfg, EngineConfig(max_batch=3, max_seq_len=128, page_size=16,
                               num_pages=96, prefill_buckets=(32, 64, 128),
                               max_new_tokens=18, temperature=0.0,
                               speculative_k=spec_k, decode_chunk=chunk,
-                              prefix_cache=prefix),
+                              prefix_cache=prefix, kv_cache_dtype=kv),
             params, tok, use_kernel=False)
         ids = [eng.submit(list(p), max_new_tokens=18) for p in plain_prompts]
         g = make_grammar("json", tok, prefer_native=False)
@@ -208,9 +210,10 @@ def test_feature_matrix_greedy_equivalence():
         jsonlib.loads(res[ids[-1]].text)      # grammar guarantee holds
         return out
 
-    baseline = run(0, 1, False)
-    for spec_k in (0, 4):
-        for chunk in (1, 16):
-            for prefix in (False, True):
-                assert run(spec_k, chunk, prefix) == baseline, (
-                    spec_k, chunk, prefix)
+    for kv in (None, "int8", "int4"):
+        baseline = run(0, 1, False, kv)
+        for spec_k in (0, 4):
+            for chunk in (1, 16):
+                for prefix in (False, True):
+                    assert run(spec_k, chunk, prefix, kv) == baseline, (
+                        kv, spec_k, chunk, prefix)
