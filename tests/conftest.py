@@ -6,12 +6,13 @@ the default platform instead."""
 import os
 import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _with_host_device_count  # noqa: E402
+
+os.environ["XLA_FLAGS"] = _with_host_device_count(
+    os.environ.get("XLA_FLAGS", ""), 8)
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
