@@ -215,6 +215,12 @@ class RCAConfig:
     locator_max_attempts: int = 3
     cypher_max_attempts: int = 3
     metapath_max_hops: int = 3
+    # per-stage decode budgets (tokens); the locator's must exceed its
+    # structured-output schema's minimal document (constrain.SchemaGrammar
+    # .min_budget — EngineBackend.start rejects budgets below it)
+    locator_max_new_tokens: int = 768
+    cypher_max_new_tokens: int = 512
+    analyzer_max_new_tokens: int = 512
     srckind_limit: int = 5
     state_limit: int = 10
     # submit all per-entity audit runs before awaiting any (SURVEY §3.4:

@@ -336,6 +336,23 @@ def _token_strings(tokenizer: Tokenizer) -> List[str]:
     return cached
 
 
+def _vocab_force_tables(strings) -> Tuple[Dict[str, int], int]:
+    """(single-char token map, force-close margin) for a vocab.
+
+    The margin encodes the force-close invariant shared by every grammar:
+    one sampled token can extend the minimal completion by a few chars per
+    character it contains (an opening brace adds a closer, a key quote
+    adds '":0', ...), while force-close emits one char per tick — so
+    multi-char vocabs must start closing earlier."""
+    char_token: Dict[str, int] = {}
+    max_chars = 1
+    for t, s in enumerate(strings):
+        if len(s) == 1 and s not in char_token:
+            char_token[s] = t
+        max_chars = max(max_chars, len(s))
+    return char_token, 2 + 4 * (max_chars - 1)
+
+
 class JsonGrammar:
     """Token-level FSM guaranteeing the generated body parses as JSON.
 
@@ -352,17 +369,8 @@ class JsonGrammar:
         self._mask_cache: Dict[Tuple, np.ndarray] = {}
         # exact single-character token ids for the force-close path (encode()
         # round trips are not identity for SentencePiece-style tokenizers)
-        self._char_token: Dict[str, int] = {}
-        max_chars = 1
-        for t, s in enumerate(self._strings):
-            if len(s) == 1 and s not in self._char_token:
-                self._char_token[s] = t
-            max_chars = max(max_chars, len(s))
-        # one sampled token can extend the minimal completion by a few chars
-        # per character it contains (an opening brace adds a closer, a key
-        # quote adds '":0', ...), while force-close emits one char per tick —
-        # so multi-char vocabs must start closing earlier
-        self._close_margin = 2 + 4 * (max_chars - 1)
+        self._char_token, self._close_margin = _vocab_force_tables(
+            self._strings)
 
     @property
     def done(self) -> bool:
@@ -427,15 +435,418 @@ class JsonGrammar:
                     f"JSON grammar in state {self.auto.state}")
 
 
-def make_grammar(name: Optional[str], tokenizer: Tokenizer,
-                 prefer_native: bool = True):
+# ---------------------------------------------------------------------------
+# schema-constrained decoding (structured outputs)
+# ---------------------------------------------------------------------------
+#
+# Where JsonGrammar guarantees "some valid JSON", SchemaGrammar guarantees a
+# SPECIFIC shape: fixed object keys in order, enum-constrained strings,
+# bounded arrays/integers.  Punctuation and keys are *forced* (the model
+# never samples them); the model only chooses at genuine decision points
+# (enum continuations, free-string characters, array continue-vs-close).
+# This is what makes the RCA locator stage (rca/locator.py) robust for ANY
+# model: even random weights yield a plan whose DestinationKind is a real
+# kind from the metagraph vocabulary — the reference can only hope GPT-4
+# follows its page-long prompt (reference
+# find_metapath/find_srckind_metapath_neo4j.py:212-238).
+#
+# Supported schema nodes (plain dicts):
+#   {"const": "text"}                      literal span (internal use)
+#   {"enum": ["A", "B", ...]}              one of the quoted literals
+#   {"type": "string", "max_len": N}       free string (no escapes)
+#   {"type": "integer", "max_digits": N}   non-negative JSON integer
+#   {"type": "boolean"}                    true | false
+#   {"type": "array", "items": S,
+#    "min_items": a, "max_items": b}       '[' items ', '-separated ']'
+#   {"type": "object", "properties":
+#    [(key, S), ...]}                      fixed keys, fixed order
+
+
+def _compile_schema(schema: Dict) -> Tuple:
+    """Schema dict -> immutable node tree."""
+    import json as _json
+
+    if "const" in schema:
+        return ("lit", schema["const"])
+    if "enum" in schema:
+        cands = tuple(str(c) for c in schema["enum"])
+        if not cands:
+            raise ValueError("enum must be non-empty")
+        for c in cands:
+            if any(ch not in _STRING_CHARS for ch in c):
+                raise ValueError(f"enum literal {c!r} has non-plain chars")
+        return ("enum", cands)
+    t = schema.get("type")
+    if t == "string":
+        return ("str", int(schema.get("max_len", 64)))
+    if t == "integer":
+        return ("int", int(schema.get("max_digits", 6)))
+    if t == "boolean":
+        return ("bool", ("true", "false"))
+    if t == "array":
+        lo = int(schema.get("min_items", 0))
+        hi = int(schema.get("max_items", 8))
+        if not (0 <= lo <= hi and hi >= 1):
+            raise ValueError(f"bad array bounds [{lo}, {hi}]")
+        return ("arr", _compile_schema(schema["items"]), lo, hi)
+    if t == "object":
+        props = schema["properties"]
+        if isinstance(props, dict):
+            props = list(props.items())
+        nodes: List[Tuple] = []
+        for i, (key, sub) in enumerate(props):
+            opener = "{" if i == 0 else ", "
+            nodes.append(("lit", f"{opener}{_json.dumps(key)}: "))
+            nodes.append(_compile_schema(sub))
+        nodes.append(("lit", "}" if props else "{}"))
+        return ("seq", tuple(nodes))
+    raise ValueError(f"unsupported schema node: {schema!r}")
+
+
+def _node_first_char(node: Tuple) -> str:
+    kind = node[0]
+    if kind == "lit":
+        return node[1][0]
+    if kind in ("str", "enum"):
+        return '"'
+    if kind == "int":
+        return "0"
+    if kind == "bool":
+        return "t"
+    if kind == "arr":
+        return "["
+    if kind == "seq":
+        return _node_first_char(node[1][0])
+    raise AssertionError(node)
+
+
+class SchemaAutomaton:
+    """Character acceptor for one schema-shaped JSON value.
+
+    Mutable frame stack; each frame is a list whose head names the kind.
+    ``accept`` consumes one character (False = illegal, state unchanged for
+    the dispatching frame); ``complete`` flips when the root value closes.
+    """
+
+    __slots__ = ("stack", "complete")
+
+    def __init__(self, root: Tuple):
+        self.stack: List[List] = []
+        self.complete = False
+        self._push(root)
+
+    def clone(self) -> "SchemaAutomaton":
+        c = SchemaAutomaton.__new__(SchemaAutomaton)
+        c.stack = [list(f) for f in self.stack]
+        c.complete = self.complete
+        return c
+
+    # ------------------------------------------------------------ frames
+
+    def _push(self, node: Tuple) -> None:
+        kind = node[0]
+        if kind == "lit":
+            self.stack.append(["lit", node[1], 0])
+        elif kind == "str":
+            self.stack.append(["str", node[1], 0, False])
+        elif kind == "enum":
+            self.stack.append(["enum", node[1], 0, False])
+        elif kind == "int":
+            self.stack.append(["int", node[1], 0, False])
+        elif kind == "bool":
+            self.stack.append(["bool", node[1], 0])
+        elif kind == "arr":
+            self.stack.append(["arr", node[1], node[2], node[3], 0, "open"])
+        elif kind == "seq":
+            self.stack.append(["seq", node[1], 0])
+            self._push(node[1][0])
+        else:
+            raise AssertionError(node)
+
+    def _pop_done(self) -> None:
+        """Top frame finished; unwind seq/arr parents."""
+        self.stack.pop()
+        while self.stack:
+            top = self.stack[-1]
+            if top[0] == "seq":
+                top[2] += 1
+                if top[2] < len(top[1]):
+                    self._push(top[1][top[2]])
+                    return
+                self.stack.pop()
+            elif top[0] == "arr":
+                top[4] += 1
+                top[5] = "after_item"
+                return
+            else:
+                raise AssertionError(top)
+        self.complete = True
+
+    # ------------------------------------------------------------ accept
+
+    def accept(self, ch: str) -> bool:
+        if self.complete:
+            return ch in WS
+        f = self.stack[-1]
+        kind = f[0]
+
+        if kind == "lit":
+            if f[1][f[2]] != ch:
+                return False
+            f[2] += 1
+            if f[2] == len(f[1]):
+                self._pop_done()
+            return True
+
+        if kind == "str":                   # [_, max_len, n, opened]
+            if not f[3]:
+                if ch == '"':
+                    f[3] = True
+                    return True
+                return False
+            if ch == '"':
+                self._pop_done()
+                return True
+            if ch in _STRING_CHARS and f[2] < f[1]:
+                f[2] += 1
+                return True
+            return False
+
+        if kind == "enum":                  # [_, cands, pos, opened]
+            if not f[3]:
+                if ch == '"':
+                    f[3] = True
+                    return True
+                return False
+            if ch == '"':
+                if any(len(c) == f[2] for c in f[1]):
+                    self._pop_done()
+                    return True
+                return False
+            nxt = tuple(c for c in f[1] if len(c) > f[2] and c[f[2]] == ch)
+            if not nxt:
+                return False
+            f[1] = nxt
+            f[2] += 1
+            return True
+
+        if kind == "int":                   # [_, max_digits, n, leading_zero]
+            if ch in DIGITS:
+                if f[2] == 0:
+                    f[2], f[3] = 1, ch == "0"
+                    return True
+                if f[3] or f[2] >= f[1]:
+                    return False
+                f[2] += 1
+                return True
+            if f[2] > 0:                    # number ends at the delimiter:
+                self._pop_done()            # pop, then re-dispatch the char
+                return self.accept(ch)
+            return False
+
+        if kind == "bool":                  # [_, cands, pos]
+            nxt = tuple(c for c in f[1] if len(c) > f[2] and c[f[2]] == ch)
+            if not nxt:
+                return False
+            f[1] = nxt
+            f[2] += 1
+            if len(f[1]) == 1 and f[2] == len(f[1][0]):
+                self._pop_done()
+            return True
+
+        if kind == "arr":                   # [_, item, lo, hi, count, state]
+            state = f[5]
+            if state == "open":
+                if ch != "[":
+                    return False
+                f[5] = "first"
+                return True
+            if state == "first":
+                if ch == "]" and f[2] == 0:
+                    self._pop_done()
+                    return True
+                depth = len(self.stack)      # a seq item pushes >1 frame
+                f[5] = "in"
+                self._push(f[1])
+                if self.accept(ch):
+                    return True
+                del self.stack[depth:]       # illegal first char: undo
+                f[5] = "first"
+                return False
+            if state == "after_item":
+                if ch == "," and f[4] < f[3]:
+                    f[5] = "sep"
+                    return True
+                if ch == "]" and f[4] >= f[2]:
+                    self._pop_done()
+                    return True
+                return False
+            if state == "sep":
+                if ch != " ":
+                    return False
+                f[5] = "in"
+                self._push(f[1])
+                return True
+            raise AssertionError(state)
+
+        raise AssertionError(kind)
+
+    # ---------------------------------------------------- forced closing
+
+    def _min_step(self) -> Optional[str]:
+        """One character of the shortest completion, or None if the step is
+        a charless transition (e.g. a finished integer popping)."""
+        f = self.stack[-1]
+        kind = f[0]
+        if kind == "lit":
+            return f[1][f[2]]
+        if kind == "str":
+            return '"'
+        if kind == "enum":
+            if not f[3]:
+                return '"'
+            best = min(f[1], key=len)
+            return '"' if len(best) == f[2] else best[f[2]]
+        if kind == "int":
+            if f[2] == 0:
+                return "0"
+            self._pop_done()                # ends at delimiter: charless pop
+            return None
+        if kind == "bool":
+            return min(f[1], key=len)[f[2]]
+        if kind == "arr":
+            state = f[5]
+            if state == "open":
+                return "["
+            if state == "first":
+                return "]" if f[2] == 0 else _node_first_char(f[1])
+            if state == "after_item":
+                return "]" if f[4] >= f[2] else ","
+            if state == "sep":
+                return " "
+        raise AssertionError(f)
+
+    def minimal_completion(self) -> str:
+        clone = self.clone()
+        out: List[str] = []
+        for _ in range(100_000):
+            if clone.complete:
+                return "".join(out)
+            ch = clone._min_step()
+            if ch is None:
+                continue
+            assert clone.accept(ch), (clone.stack, ch)
+            out.append(ch)
+        raise AssertionError("schema completion did not converge")
+
+    def state_key(self) -> Tuple:
+        return (self.complete, tuple(tuple(f) for f in self.stack))
+
+
+class SchemaGrammar:
+    """Token-level FSM enforcing a schema template (structured outputs).
+
+    Same engine protocol as JsonGrammar: ``constraint(remaining)`` /
+    ``advance(token)``.  Literal spans are *forced* as the longest matching
+    vocab token, so skeleton text costs one forced token per tick (one per
+    char on byte vocabs) and zero sampling."""
+
+    def __init__(self, schema: Dict, tokenizer: Tokenizer):
+        self.tokenizer = tokenizer
+        self.root = _compile_schema(schema)
+        self.auto = SchemaAutomaton(self.root)
+        self.eos_id = tokenizer.eos_id
+        self._strings = _token_strings(tokenizer)
+        self._mask_cache: Dict[Tuple, np.ndarray] = {}
+        self._char_token, self._close_margin = _vocab_force_tables(
+            self._strings)
+
+    @property
+    def done(self) -> bool:
+        return self.auto.complete
+
+    def min_budget(self) -> int:
+        """Smallest max_new_tokens that can hold a valid document (worst
+        case one char per token).  Budgets below this cannot terminate in a
+        parseable state; EngineBackend.start rejects them."""
+        return len(SchemaAutomaton(self.root).minimal_completion()) \
+            + self._close_margin
+
+    def _force_char(self, ch: str) -> Constraint:
+        forced = self._char_token.get(ch)
+        if forced is None:
+            forced = self.tokenizer.encode(ch)[0]
+        return Constraint(force=forced)
+
+    def _forced_literal(self) -> Optional[Constraint]:
+        """When the automaton sits in a literal span, force the longest
+        token lying entirely inside the remaining span."""
+        f = self.auto.stack[-1] if self.auto.stack else None
+        if f is None or f[0] != "lit":
+            return None
+        upcoming = f[1][f[2]:]
+        best = self._char_token.get(upcoming[0])
+        best_len = 1 if best is not None else 0
+        if len(upcoming) > 1:
+            for t, s in enumerate(self._strings):
+                if len(s) > best_len and len(s) <= len(upcoming) \
+                        and upcoming.startswith(s):
+                    best, best_len = t, len(s)
+        if best is None:
+            return None                     # no in-span token: mask instead
+        return Constraint(force=best)
+
+    def constraint(self, remaining: Optional[int] = None) -> Constraint:
+        if self.auto.complete:
+            return Constraint(force=self.eos_id)
+        if remaining is not None:
+            completion = self.auto.minimal_completion()
+            if remaining <= len(completion) + self._close_margin:
+                if not completion:
+                    return Constraint(force=self.eos_id)
+                return self._force_char(completion[0])
+        forced = self._forced_literal()
+        if forced is not None:
+            return forced
+        key = self.auto.state_key()
+        allow = self._mask_cache.get(key)
+        if allow is None:
+            allow = np.zeros((self.tokenizer.vocab_size,), bool)
+            for t, s in enumerate(self._strings):
+                if not s or all(c in WS for c in s):
+                    continue
+                sim = self.auto.clone()
+                if all(sim.accept(c) for c in s):
+                    allow[t] = True
+            if self.auto.complete:
+                allow[self.eos_id] = True
+            self._mask_cache[key] = allow
+        if not allow.any():
+            return self._force_char(self.auto.minimal_completion()[0])
+        return Constraint(allow=allow)
+
+    def advance(self, token: int) -> None:
+        if token == self.eos_id:
+            return
+        for ch in self._strings[token]:
+            if not self.auto.accept(ch):
+                raise ValueError(
+                    f"token {token} ({self._strings[token]!r}) violates the "
+                    f"schema grammar at {self.auto.stack[-1:]!r}")
+
+
+def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
     """GenOptions.grammar -> FSM instance (None = unconstrained).
 
-    Prefers the C++ engine (native/, mask computation is O(V·len) per tick)
-    and falls back to the Python FSM when no toolchain is available; the
-    two are mask-for-mask identical (tests/test_native.py)."""
+    ``name`` may be the string "json" (any-JSON grammar; prefers the C++
+    engine in native/, mask computation is O(V·len) per tick, and falls
+    back to the Python FSM — the two are mask-for-mask identical,
+    tests/test_native.py) or a schema dict (SchemaGrammar structured
+    output)."""
     if name is None:
         return None
+    if isinstance(name, dict):
+        return SchemaGrammar(name, tokenizer)
     if name == "json":
         if prefer_native:
             try:
@@ -445,4 +856,5 @@ def make_grammar(name: Optional[str], tokenizer: Tokenizer,
             except Exception as e:           # toolchain/ABI trouble: fall back
                 get_logger(__name__).debug("native grammar unavailable: %s", e)
         return JsonGrammar(tokenizer)
-    raise ValueError(f"unknown grammar {name!r} (supported: 'json')")
+    raise ValueError(f"unknown grammar {name!r} (supported: 'json' or a "
+                     f"schema dict)")

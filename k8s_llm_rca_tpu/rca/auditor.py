@@ -19,6 +19,7 @@ Behavior-equivalent to the reference's check_state package
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Tuple
 
 from k8s_llm_rca_tpu.rca import entity
@@ -66,13 +67,26 @@ def setup_state_semantic_analyzer(service: AssistantService,
 
 # ---------------------------------------------------------------------------
 # temporal state queries (string builders, matching the reference signatures;
-# values are repr-escaped rather than f-string-injected raw)
+# values are repr-escaped; labels — which Cypher cannot parameterize — are
+# whitelisted to bare identifiers so graph-sourced kinds can't inject)
 # ---------------------------------------------------------------------------
+
+_LABEL_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*\Z")
+
+
+def _safe_label(kind: str) -> str:
+    """Cypher label positions can't take query parameters; restrict them to
+    bare identifiers (the whole kind vocabulary is) so a hostile kind value
+    coming out of the stategraph can't splice clauses into the query."""
+    if not _LABEL_RE.match(kind or ""):
+        raise ValueError(f"unsafe entity kind for a Cypher label: {kind!r}")
+    return kind
 
 
 def find_loose_states(entity_kind: str, entity_id: str,
                       tmin: str, tmax: str, limit: int = 10) -> str:
     """[E.tmin, E.tmax) must overlap [S.tmin, S.tmax)."""
+    entity_kind = _safe_label(entity_kind)
     state_kind = entity_kind.upper()
     return f"""
     MATCH (n1:{entity_kind})-[r1:HasState]->(n2:{state_kind})
@@ -88,6 +102,7 @@ def find_strict_states(entity_kind: str, entity_id: str,
     """Event timestamp must fall in [S.tmin, S.tmax).  Half-open on the
     right so one timestamp lands in exactly one interval (the reference
     documents this rationale at :62-68)."""
+    entity_kind = _safe_label(entity_kind)
     state_kind = entity_kind.upper()
     return f"""
     MATCH (n1:{entity_kind})-[r1:HasState]->(n2:{state_kind})
@@ -100,6 +115,7 @@ def find_strict_states(entity_kind: str, entity_id: str,
 
 def ad_hoc_find_entity_name(entity_kind: str, entity_id: str,
                             query_executor) -> str:
+    entity_kind = _safe_label(entity_kind)
     records = query_executor.run_query(f"""
     MATCH (n1:{entity_kind})
     WHERE n1.id = {entity_id!r}

@@ -42,15 +42,44 @@ resolve the problem.  You never invent kinds outside the provided lists and
 you answer strictly in the requested JSON structure."""
 
 
-def setup_root_cause_locator(service: AssistantService,
-                             model: str = "local",
-                             max_new_tokens: int = 512) -> GenericAssistant:
+def plan_schema(kind_vocabulary: Sequence[str]) -> Dict[str, Any]:
+    """Structured-output schema for the destKind plan: the exact fenced-JSON
+    contract of the reference prompt (reference
+    find_metapath/find_srckind_metapath_neo4j.py:212-238), with every kind
+    field constrained to the metagraph vocabulary.  Under this schema ANY
+    model — including an un-finetuned or random-weight one — produces a
+    structurally valid plan naming real kinds; the reference can only hope
+    GPT-4 complies and retry when it doesn't."""
+    kind = {"enum": sorted(set(kind_vocabulary))}
+    return {"type": "object", "properties": [
+        ("SourceKind", kind),
+        ("DestinationKind", kind),
+        ("RelevantResources",
+         {"type": "array", "items": kind, "min_items": 1, "max_items": 6}),
+        ("PrimaryPath",
+         {"type": "array", "min_items": 1, "max_items": 5,
+          "items": {"type": "object", "properties": [
+              ("Edge", {"type": "integer", "max_digits": 2}),
+              ("start", kind),
+              ("end", kind)]}}),
+    ]}
+
+
+def setup_root_cause_locator(
+        service: AssistantService, model: str = "local",
+        max_new_tokens: int = 768,
+        kind_vocabulary: Optional[Sequence[str]] = None) -> GenericAssistant:
+    """``kind_vocabulary``: when given, decode is schema-constrained to the
+    plan contract with kinds restricted to this vocabulary (structured
+    outputs); otherwise any-JSON grammar (the round-1 behavior)."""
+    grammar: Any = (plan_schema(kind_vocabulary) if kind_vocabulary
+                    else "json")
     locator = GenericAssistant(service)
     locator.create_assistant(
         LOCATOR_INSTRUCTIONS, "k8s-root-cause-locator", model,
         gen=GenOptions(max_new_tokens=max_new_tokens,
                        forced_prefix="```json\n", stop=("```",),
-                       suffix="\n```", grammar="json"))
+                       suffix="\n```", grammar=grammar))
     locator.create_thread()
     return locator
 

@@ -43,16 +43,22 @@ class RCAPipeline:
     reranker: Optional[Any] = None
 
     def __post_init__(self):
-        self.locator = locator.setup_root_cause_locator(
-            self.service, self.cfg.model)
+        # vocabulary first: the locator's structured-output schema constrains
+        # every kind field to it (locator.plan_schema)
         self.native_kinds, self.external_kinds = \
             locator.find_native_external_kinds(self.meta_executor)
+        self.locator = locator.setup_root_cause_locator(
+            self.service, self.cfg.model,
+            max_new_tokens=self.cfg.locator_max_new_tokens,
+            kind_vocabulary=self.native_kinds + self.external_kinds)
         self.prompt_template = locator.build_prompt_template(
             self.native_kinds, self.external_kinds)
         self.cypher_generator = cyphergen.setup_cypher_generator(
-            self.service, self.cfg.model)
+            self.service, self.cfg.model,
+            max_new_tokens=self.cfg.cypher_max_new_tokens)
         self.analyzer = auditor.setup_state_semantic_analyzer(
-            self.service, self.cfg.model)
+            self.service, self.cfg.model,
+            max_new_tokens=self.cfg.analyzer_max_new_tokens)
 
     # ------------------------------------------------------------ stage 1
 

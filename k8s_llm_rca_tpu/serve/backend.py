@@ -33,9 +33,11 @@ class GenOptions:
     forced_prefix: str = ""     # emitted verbatim, prefilled as forced tokens
     suffix: str = ""            # appended verbatim after generation stops
     # grammar-constrained decode of the BODY (engine/constrain.py): "json"
-    # guarantees the generated text parses; composes with forced_prefix /
-    # suffix carrying the fences.  None = unconstrained.
-    grammar: Optional[str] = None
+    # guarantees the generated text parses; a schema dict
+    # (constrain.SchemaGrammar) additionally forces the exact shape
+    # (structured outputs).  Composes with forced_prefix / suffix carrying
+    # the fences.  None = unconstrained.
+    grammar: Optional[object] = None
 
 
 @dataclass
@@ -70,6 +72,23 @@ class EngineBackend:
         ids = self.tokenizer.encode(prompt + opts.forced_prefix, add_bos=True)
         grammar = make_grammar(opts.grammar, self.tokenizer,
                                prefer_native=self.engine.engine_cfg.native)
+        min_budget = getattr(grammar, "min_budget", None)
+        if min_budget is not None:
+            # check the budget AFTER engine clamping: a long prompt shrinks
+            # max_new below the request (engine._clamp_prompt), and a
+            # sub-minimal effective budget can only produce truncated,
+            # unparseable output — fail loudly instead
+            _, effective = self.engine._clamp_prompt(ids,
+                                                     opts.max_new_tokens)
+            if effective < min_budget():
+                raise ValueError(
+                    f"effective token budget {effective} (requested "
+                    f"{opts.max_new_tokens}, clamped by prompt length "
+                    f"{len(ids)} vs cache cap "
+                    f"{self.engine.engine_cfg.max_seq_len}) cannot hold "
+                    f"the schema's minimal document ({min_budget()} tokens "
+                    f"worst case); no valid output exists under this "
+                    f"budget")
         # a grammar owns termination (forced EOS when the value closes);
         # stop strings must not also apply — e.g. "```" is a legal substring
         # INSIDE a JSON string, and a stop match there would truncate the

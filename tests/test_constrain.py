@@ -169,3 +169,184 @@ class TestBackendIntegration:
         with pytest.raises(ValueError):
             make_grammar("yaml", get_tokenizer())
         assert make_grammar(None, get_tokenizer()) is None
+
+
+# ---------------------------------------------------------------------------
+# schema-constrained decoding (structured outputs)
+# ---------------------------------------------------------------------------
+
+KINDS = ("ConfigMap", "Pod", "PodDisruptionBudget", "Secret", "nfs")
+
+PLAN_SCHEMA = {"type": "object", "properties": [
+    ("SourceKind", {"enum": list(KINDS)}),
+    ("DestinationKind", {"enum": list(KINDS)}),
+    ("RelevantResources", {"type": "array", "items": {"enum": list(KINDS)},
+                           "min_items": 1, "max_items": 5}),
+    ("PrimaryPath", {"type": "array", "min_items": 1, "max_items": 4,
+                     "items": {"type": "object", "properties": [
+                         ("Edge", {"type": "integer", "max_digits": 2}),
+                         ("start", {"enum": list(KINDS)}),
+                         ("end", {"enum": list(KINDS)})]}}),
+]}
+
+
+def schema_feed(schema, text):
+    from k8s_llm_rca_tpu.engine.constrain import (
+        SchemaAutomaton, _compile_schema,
+    )
+
+    a = SchemaAutomaton(_compile_schema(schema))
+    for ch in text:
+        if not a.accept(ch):
+            return None
+    return a
+
+
+class TestSchemaAutomaton:
+    def test_accepts_conforming_document(self):
+        doc = ('{"SourceKind": "Pod", "DestinationKind": "Secret", '
+               '"RelevantResources": ["Pod", "nfs"], '
+               '"PrimaryPath": [{"Edge": 1, "start": "Pod", "end": "Secret"},'
+               ' {"Edge": 12, "start": "PodDisruptionBudget", "end": "nfs"}]}')
+        a = schema_feed(PLAN_SCHEMA, doc)
+        assert a is not None and a.complete
+        json.loads(doc)
+
+    @pytest.mark.parametrize("doc", [
+        '{"SourceKind": "Pox',                  # not an enum continuation
+        '{"sourceKind',                         # wrong key
+        '{"SourceKind": "Pod", "DestinationKind": "Pod", '
+        '"RelevantResources": [], ',            # below min_items
+        '{"SourceKind": "Pod", "DestinationKind": "Pod", '
+        '"RelevantResources": ["Pod", "Pod", "Pod", "Pod", "Pod", "P',
+        '{"SourceKind": 3',                     # wrong type
+    ])
+    def test_rejects_nonconforming(self, doc):
+        assert schema_feed(PLAN_SCHEMA, doc) is None
+
+    def test_enum_prefix_ambiguity(self):
+        # "Pod" is a strict prefix of "PodDisruptionBudget": both the early
+        # close and the continuation must be legal at the fork
+        head = '{"SourceKind": "Pod'
+        a = schema_feed(PLAN_SCHEMA, head)
+        assert a.clone().accept('"')
+        assert a.clone().accept('D')
+        assert not a.clone().accept('X')
+
+    @pytest.mark.parametrize("prefix", [
+        '', '{', '{"SourceKind": "', '{"SourceKind": "PodD',
+        '{"SourceKind": "Pod", "DestinationKind": "nfs", '
+        '"RelevantResources": ["Secret"',
+        '{"SourceKind": "Pod", "DestinationKind": "Pod", '
+        '"RelevantResources": ["Pod"], "PrimaryPath": [{"Edge": 4',
+    ])
+    def test_minimal_completion_closes_any_prefix(self, prefix):
+        a = schema_feed(PLAN_SCHEMA, prefix)
+        assert a is not None, prefix
+        completion = a.minimal_completion()
+        done = schema_feed(PLAN_SCHEMA, prefix + completion)
+        assert done is not None and done.complete
+        parsed = json.loads(prefix + completion)
+        assert parsed["DestinationKind"] in KINDS
+
+    def test_integer_rules(self):
+        schema = {"type": "object",
+                  "properties": [("n", {"type": "integer", "max_digits": 3})]}
+        assert schema_feed(schema, '{"n": 0}').complete
+        assert schema_feed(schema, '{"n": 123}').complete
+        assert schema_feed(schema, '{"n": 01') is None      # leading zero
+        assert schema_feed(schema, '{"n": 1234') is None    # over max_digits
+
+    def test_boolean_and_free_string(self):
+        schema = {"type": "object", "properties": [
+            ("ok", {"type": "boolean"}),
+            ("note", {"type": "string", "max_len": 4})]}
+        assert schema_feed(schema, '{"ok": true, "note": "ab"}').complete
+        assert schema_feed(schema, '{"ok": false, "note": ""}').complete
+        assert schema_feed(schema, '{"ok": maybe') is None
+        assert schema_feed(schema, '{"ok": true, "note": "abcde') is None
+
+
+class TestSchemaGrammar:
+    def _random_walk(self, grammar, tok, budget, seed=0, pick="choice"):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        out = []
+        for step in range(budget):
+            c = grammar.constraint(remaining=budget - step)
+            if c.force is not None:
+                t = c.force
+            else:
+                allowed = np.flatnonzero(c.allow)
+                t = int(allowed[-1]) if pick == "last" \
+                    else int(rng.choice(allowed))
+            if t == tok.eos_id:
+                return out
+            grammar.advance(t)
+            out.append(t)
+        raise AssertionError("schema decode never terminated")
+
+    def test_random_walk_parses_and_respects_enums(self):
+        from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
+
+        tok = get_tokenizer()
+        for seed in range(3):
+            g = SchemaGrammar(PLAN_SCHEMA, tok)
+            ids = self._random_walk(g, tok, budget=600, seed=seed)
+            parsed = json.loads(tok.decode(ids))
+            assert set(parsed) == {"SourceKind", "DestinationKind",
+                                   "RelevantResources", "PrimaryPath"}
+            assert parsed["DestinationKind"] in KINDS
+            assert all(r in KINDS for r in parsed["RelevantResources"])
+            for edge in parsed["PrimaryPath"]:
+                assert edge["start"] in KINDS and edge["end"] in KINDS
+
+    def test_budget_force_close_still_parses(self):
+        from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
+
+        tok = get_tokenizer()
+        g = SchemaGrammar(PLAN_SCHEMA, tok)
+        lo = g.min_budget()
+        for budget in (lo + 1, lo + 30):
+            g = SchemaGrammar(PLAN_SCHEMA, tok)
+            ids = self._random_walk(g, tok, budget=budget, pick="last")
+            json.loads(tok.decode(ids))
+
+    def test_min_budget_rejected_by_backend(self):
+        from k8s_llm_rca_tpu.serve.api import AssistantService
+        from k8s_llm_rca_tpu.serve.backend import EngineBackend, GenOptions
+
+        cfg = TINY.replace(max_seq_len=256)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch=2, max_seq_len=256,
+                            prefill_buckets=(64,))
+        backend = EngineBackend(InferenceEngine(cfg, ecfg, params,
+                                                get_tokenizer()))
+        with pytest.raises(ValueError, match="minimal document"):
+            backend.start("p", GenOptions(max_new_tokens=8,
+                                          grammar=PLAN_SCHEMA))
+
+    def test_engine_decode_under_schema(self):
+        from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
+
+        cfg = TINY.replace(max_seq_len=1024)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch=2, max_seq_len=1024,
+                            prefill_buckets=(64,), max_new_tokens=512,
+                            temperature=1.0)
+        tok = get_tokenizer()
+        eng = InferenceEngine(cfg, ecfg, params, tok)
+        sid = eng.submit(tok.encode("plan the incident", add_bos=True),
+                         max_new_tokens=512,
+                         grammar=SchemaGrammar(PLAN_SCHEMA, tok))
+        (res,) = eng.run_to_completion()
+        assert res.seq_id == sid
+        parsed = json.loads(res.text)
+        assert parsed["DestinationKind"] in KINDS
+
+    def test_make_grammar_accepts_schema_dict(self):
+        from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
+
+        g = make_grammar(PLAN_SCHEMA, get_tokenizer())
+        assert isinstance(g, SchemaGrammar)
