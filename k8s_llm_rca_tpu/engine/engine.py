@@ -59,6 +59,76 @@ def flash_prefill_safe(params) -> bool:
     return True
 
 
+def params_multi_device(params) -> bool:
+    """True when any param leaf carries a >1-device sharding (TP/EP)."""
+    for leaf in jax.tree.leaves(params):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and getattr(sharding, "num_devices", 1) > 1:
+            return True
+    return False
+
+
+def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg) -> None:
+    """TP cache-sharding preconditions: the merged kv axis splits over
+    "model" head-aligned (see runtime.sharding.kv_cache_specs) and the
+    slot batch over "data"."""
+    if tp_mesh is None:
+        return
+    for axis in ("data", "model"):
+        if axis not in tp_mesh.shape:
+            raise ValueError(f"tp_mesh needs a '{axis}' axis, has "
+                             f"{dict(tp_mesh.shape)}")
+    if model_cfg.kv_dim % (2 * tp_mesh.shape["model"]):
+        # the factor 2 keeps the nibble-packed int4 layout shardable too
+        raise ValueError(
+            f"kv_dim={model_cfg.kv_dim} not shardable over model axis "
+            f"{tp_mesh.shape['model']}")
+    if engine_cfg.max_batch % tp_mesh.shape["data"]:
+        raise ValueError(
+            f"max_batch={engine_cfg.max_batch} not divisible by data axis "
+            f"{tp_mesh.shape['data']}")
+
+
+def validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh) -> None:
+    """EP serving preconditions: MoE model; mesh carries "data" and
+    "expert" axes; decode batch and prefill buckets divide by the token
+    sharding (tokens shard over data*expert, parallel/moe.py); CP+EP in
+    one engine is unsupported (the CP prefill path is not EP-aware)."""
+    if ep_mesh is None:
+        return
+    if model_cfg.n_experts <= 0:
+        raise ValueError("ep_mesh requires an MoE model (n_experts > 0)")
+    if cp_mesh is not None:
+        raise ValueError("ep_mesh and cp_mesh are mutually exclusive")
+    for axis in ("data", "expert"):
+        if axis not in ep_mesh.shape:
+            raise ValueError(f"ep_mesh needs a '{axis}' axis, has "
+                             f"{dict(ep_mesh.shape)}")
+    p_tok = ep_mesh.shape["data"] * ep_mesh.shape["expert"]
+    if model_cfg.n_experts % ep_mesh.shape["expert"]:
+        raise ValueError(
+            f"n_experts={model_cfg.n_experts} not divisible by expert "
+            f"axis {ep_mesh.shape['expert']}")
+    if engine_cfg.max_batch % p_tok:
+        raise ValueError(
+            f"max_batch={engine_cfg.max_batch} not divisible by "
+            f"data*expert={p_tok} (decode tokens shard over both)")
+    for b in tuple(engine_cfg.prefill_buckets) + (engine_cfg.max_seq_len,):
+        if b % p_tok:
+            raise ValueError(
+                f"prefill bucket {b} not divisible by data*expert={p_tok}")
+    if engine_cfg.paged and engine_cfg.prefix_cache \
+            and engine_cfg.page_size % p_tok:
+        # the prefix-cache chunked prefill runs at ANY page-multiple width
+        # (capped by remaining pages), so every width is divisible only if
+        # one page already is — fail at construction, not mid-serve
+        raise ValueError(
+            f"page_size={engine_cfg.page_size} not divisible by "
+            f"data*expert={p_tok}: the prefix-cache chunked prefill can "
+            f"emit any page-multiple width; use a larger page_size or "
+            f"prefix_cache=False")
+
+
 def validate_cp_divisibility(cp_seq_axis: str, n_cp: int, sizes) -> None:
     """CP prefill shards the padded sequence over the mesh axis; every
     prefill bucket (and max_seq_len — paged callers pass page-rounded
@@ -448,13 +518,22 @@ class InferenceEngine(EngineBase):
         cp_mesh=None,
         cp_seq_axis: str = "seq",
         cp_mode: str = "ring",
+        ep_mesh=None,
+        tp_mesh=None,
     ):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         then runs context-parallel over it (long-context mode; the axis
         size must divide every prefill bucket and max_seq_len, validated
         below).  ``cp_mode``: "ring" (ppermute KV rotation) or "ulysses"
         (head<->seq all-to-all).  Decode is unaffected (its per-step KV is
-        one token)."""
+        one token).
+
+        ``ep_mesh``: optional Mesh with "data" and "expert" axes — every
+        MoE MLP (prefill AND decode) dispatches through the all-to-all
+        expert-parallel path (parallel/moe.py) with experts sharded over
+        "expert" (BASELINE configs[3]: Mixtral EP serving).  Requires an
+        MoE model and token counts divisible by the mesh (validated
+        below)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
         if cp_mesh is not None:
@@ -462,6 +541,8 @@ class InferenceEngine(EngineBase):
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],
                 tuple(engine_cfg.prefill_buckets)
                 + (engine_cfg.max_seq_len,))
+        validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
+        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg)
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params
@@ -481,6 +562,22 @@ class InferenceEngine(EngineBase):
             model_cfg, b, engine_cfg.max_seq_len,
             kv_dtype={"int8": jnp.int8, "int4": "int4", None: None}[
                 engine_cfg.kv_cache_dtype])
+        if tp_mesh is not None:
+            # place the cache sharded from the start (merged kv axis over
+            # "model", slots over "data") so each device holds 1/P of the
+            # KV bytes — the real memory win of serving TP
+            from jax.sharding import PartitionSpec as _P
+
+            from k8s_llm_rca_tpu.runtime.sharding import (
+                kv_cache_specs, shard_pytree,
+            )
+
+            kv_spec = kv_cache_specs()
+            self.cache = shard_pytree(
+                self.cache,
+                llama.KVCache(kv_spec, kv_spec,
+                              _P(None, "data", None), _P(None, "data", None)),
+                tp_mesh)
         self.lengths = jnp.zeros((b,), jnp.int32)
         self.cur_tokens = jnp.zeros((b,), jnp.int32)
         self._key = jax.random.PRNGKey(engine_cfg.seed)
@@ -499,18 +596,22 @@ class InferenceEngine(EngineBase):
         else:
             use_flash = flash_prefill_safe(params)
             self._prefill = jax.jit(
-                functools.partial(llama.prefill, use_flash=use_flash),
+                functools.partial(llama.prefill, use_flash=use_flash,
+                                  ep_mesh=ep_mesh),
                 static_argnums=0)
             self._prefill_batch = jax.jit(
-                functools.partial(llama.prefill_batch, use_flash=use_flash),
+                functools.partial(llama.prefill_batch, use_flash=use_flash,
+                                  ep_mesh=ep_mesh),
                 static_argnums=0)
         # batched admission needs the plain prefill path (prefill_cp is
         # per-sequence)
         self._batch_admission = cp_mesh is None
-        self._decode = jax.jit(llama.decode_step, static_argnums=0)
+        self._decode = jax.jit(
+            functools.partial(llama.decode_step, ep_mesh=ep_mesh),
+            static_argnums=0)
         def _verify_step(cfg, params, cache, tokens, lengths):
             cache, logits = llama.decode_multi(cfg, params, cache, tokens,
-                                               lengths)
+                                               lengths, ep_mesh=ep_mesh)
             # greedy choices computed on device: the [B, T] int transfer is
             # 32000x smaller than the logits; full logits leave the device
             # only for grammar slots (fetched lazily by the caller)
@@ -519,7 +620,9 @@ class InferenceEngine(EngineBase):
         self._decode_multi = jax.jit(_verify_step, static_argnums=0)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
-        self._decode_scan = jax.jit(decode_scan, static_argnums=(0, 6, 7, 8))
+        self._decode_scan = jax.jit(
+            functools.partial(decode_scan, ep_mesh=ep_mesh),
+            static_argnums=(0, 6, 7, 8))
         self._prompts: Dict[int, List[int]] = {}   # seq_id -> prompt (for
         # n-gram draft lookup; dropped at retirement)
 
@@ -776,6 +879,7 @@ def decode_scan(
     n_steps: int,
     sampling: SamplingParams = SamplingParams(),
     eos_id: int = -1,
+    ep_mesh=None,
 ) -> Tuple[llama.KVCache, jnp.ndarray, jnp.ndarray]:
     """Decode ``n_steps`` for the whole batch with zero host sync.
 
@@ -785,7 +889,8 @@ def decode_scan(
 
     def body(carry, _):
         cache, cur, lens, done, key = carry
-        cache, logits = llama.decode_step(cfg, params, cache, cur, lens)
+        cache, logits = llama.decode_step(cfg, params, cache, cur, lens,
+                                          ep_mesh)
         key, sub = jax.random.split(key)
         nxt = sample_tokens(logits, sub, sampling)
         newly_done = done | (nxt == eos_id)

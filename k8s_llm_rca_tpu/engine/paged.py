@@ -195,15 +195,18 @@ def init_paged_cache(cfg: ModelConfig, n_pages: int, page_size: int,
     if isinstance(kv_dtype, str) and kv_dtype == "int4":
         assert cfg.kv_dim % 2 == 0
         pshape = (*shape[:3], cfg.kv_dim // 2)
+        # scale pools live in f32: they are tiny next to the pages
+        # (1/kv_dim of the bytes) and f32 storage saves the quantized
+        # kernel a bf16->f32 re-cast of both pools on every layer call
         return PagePool(k=jnp.zeros(pshape, jnp.int8),
                         v=jnp.zeros(pshape, jnp.int8),
-                        k_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)),
-                        v_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)))
+                        k_scale=jnp.zeros(shape[:3], jnp.float32),
+                        v_scale=jnp.zeros(shape[:3], jnp.float32))
     if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
         return PagePool(k=jnp.zeros(shape, jnp.int8),
                         v=jnp.zeros(shape, jnp.int8),
-                        k_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)),
-                        v_scale=jnp.zeros(shape[:3], jnp.dtype(cfg.dtype)))
+                        k_scale=jnp.zeros(shape[:3], jnp.float32),
+                        v_scale=jnp.zeros(shape[:3], jnp.float32))
     dtype = jnp.dtype(cfg.dtype)
     return PagePool(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
@@ -250,7 +253,8 @@ def _write_pool_pages(cfg: ModelConfig, pool: PagePool, new_k, new_v,
 
 def paged_prefill(cfg: ModelConfig, params, pool: PagePool,
                   tokens: jnp.ndarray, length: jnp.ndarray,
-                  page_map: jnp.ndarray, use_flash: bool = False):
+                  page_map: jnp.ndarray, use_flash: bool = False,
+                  ep_mesh=None):
     """Prefill ONE sequence, scattering its KV into ``page_map`` pages.
 
     tokens [1, S_pad] with S_pad a multiple of page_size; page_map
@@ -262,7 +266,7 @@ def paged_prefill(cfg: ModelConfig, params, pool: PagePool,
     page_size = pool.page_size
     assert s_pad % page_size == 0, (s_pad, page_size)
     new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length,
-                                            use_flash)
+                                            use_flash, ep_mesh)
     pool = _write_pool_pages(cfg, pool, new_k, new_v, page_map,
                              s_pad // page_size, page_size)
     return pool, logits
@@ -292,7 +296,8 @@ def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
 
 def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, lengths: jnp.ndarray,
-                        page_maps: jnp.ndarray, use_flash: bool = False):
+                        page_maps: jnp.ndarray, use_flash: bool = False,
+                        ep_mesh=None):
     """Prefill N sequences into their pool pages in ONE dispatch.
 
     tokens [N, S_pad] right-padded (S_pad a page multiple); lengths [N];
@@ -306,7 +311,8 @@ def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
     assert s_pad % page_size == 0, (s_pad, page_size)
     n_seq_pages = s_pad // page_size
     new_k, new_v, logits = llama._prefill_batch_kv(cfg, params, tokens,
-                                                   lengths, use_flash)
+                                                   lengths, use_flash,
+                                                   ep_mesh)
     # fold the batch dim into the page dim: the single-sequence write
     # helper scatters [L, total_pages, page, kv] by a flat page map
     pool = _write_pool_pages(
@@ -338,7 +344,7 @@ def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
 def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, chunk_len: jnp.ndarray,
                         prefix_len: jnp.ndarray, prefix_table: jnp.ndarray,
-                        page_map: jnp.ndarray):
+                        page_map: jnp.ndarray, ep_mesh=None):
     """Prefill the non-cached SUFFIX of a prompt whose first ``prefix_len``
     tokens' KV already sit in pool pages (prefix-cache hit).
 
@@ -385,7 +391,7 @@ def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
                                 jnp.concatenate([vp, v], axis=1), mask)
         x = x + attn.reshape(1, c_pad, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + llama._mlp(cfg, layer, hm)
+        x = x + llama._mlp(cfg, layer, hm, ep_mesh)
         ks.append(k[0])
         vs.append(v[0])
 
@@ -402,7 +408,7 @@ def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
 def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
                       tokens: jnp.ndarray, lengths: jnp.ndarray,
                       block_tables: jnp.ndarray, *,
-                      use_kernel: Optional[bool] = None):
+                      use_kernel: Optional[bool] = None, ep_mesh=None):
     """One decode step for all sequences over the paged pool.
 
     tokens [B]; lengths [B] tokens already cached; block_tables
@@ -465,7 +471,7 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
             attn = attn_fn(q[:, 0], kp, vp, lengths + 1, block_tables)
         x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + llama._mlp(cfg, layer, hm)
+        x = x + llama._mlp(cfg, layer, hm, ep_mesh)
 
     logits = llama._logits(cfg, params, x)[:, 0]
     return pool, logits
@@ -473,7 +479,7 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
 
 def paged_decode_multi(cfg: ModelConfig, params, pool: PagePool,
                        tokens: jnp.ndarray, lengths: jnp.ndarray,
-                       block_tables: jnp.ndarray):
+                       block_tables: jnp.ndarray, ep_mesh=None):
     """Multi-token paged decode (speculative verification).
 
     tokens [B, T]: tokens[b, 0] is the current token, the rest drafts;
@@ -526,7 +532,7 @@ def paged_decode_multi(cfg: ModelConfig, params, pool: PagePool,
         attn = decode_attention_multi(q, k_all, v_all, lengths + 1)
         x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + llama._mlp(cfg, layer, hm)
+        x = x + llama._mlp(cfg, layer, hm, ep_mesh)
 
     logits = llama._logits(cfg, params, x)                       # [B, T, V]
     return pool, jnp.argmax(logits, axis=-1), logits
@@ -536,7 +542,7 @@ def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
                       cur_tokens: jnp.ndarray, lengths: jnp.ndarray,
                       block_tables: jnp.ndarray, key, n_steps: int,
                       sampling: SamplingParams, eos_id: int,
-                      use_kernel: Optional[bool] = None):
+                      use_kernel: Optional[bool] = None, ep_mesh=None):
     """``n_steps`` paged decode steps with zero host sync (the paged
     engine's chunked tick).  Valid only while no sequence crosses a page
     boundary — the caller bounds ``n_steps`` by each slot's distance to
@@ -549,7 +555,8 @@ def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
         pool, cur, lens, done, key = carry
         pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
                                          block_tables,
-                                         use_kernel=use_kernel)
+                                         use_kernel=use_kernel,
+                                         ep_mesh=ep_mesh)
         key, sub = jax.random.split(key)
         nxt = sample_tokens(logits, sub, sampling)
         newly_done = done | (nxt == eos_id)
@@ -591,7 +598,7 @@ class PagedInferenceEngine(EngineBase):
                  params, tokenizer: Tokenizer,
                  use_kernel: Optional[bool] = None,
                  cp_mesh=None, cp_seq_axis: str = "seq",
-                 cp_mode: str = "ring"):
+                 cp_mode: str = "ring", ep_mesh=None, tp_mesh=None):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         runs context-parallel over it (ring or Ulysses, as in the
         contiguous engine) and scatters the full-depth KV into pool pages.
@@ -601,6 +608,20 @@ class PagedInferenceEngine(EngineBase):
         context-parallel)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
+        from k8s_llm_rca_tpu.engine.engine import (
+            params_multi_device, validate_ep_mesh, validate_tp_mesh,
+        )
+        validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
+        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg)
+        if use_kernel and (tp_mesh is not None or params_multi_device(params)):
+            # pallas_call has no SPMD partitioning rule: the paged kernel
+            # would silently replicate per-device instead of sharding
+            raise ValueError("use_kernel=True is incompatible with sharded "
+                             "params / tp_mesh (no SPMD rule for Pallas); "
+                             "the XLA paged-attention path shards correctly")
+        if use_kernel is None and (tp_mesh is not None
+                                   or params_multi_device(params)):
+            use_kernel = False
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
                 raise ValueError(
@@ -648,6 +669,20 @@ class PagedInferenceEngine(EngineBase):
         self.pool = init_paged_cache(
             model_cfg, engine_cfg.num_pages, self.page_size,
             kv_dtype=engine_cfg.kv_cache_dtype)
+        if tp_mesh is not None:
+            # pool pages sharded on the merged kv axis over "model": each
+            # device stores 1/P of every page's bytes (the paged analog of
+            # kv_cache_specs); tiny per-token scale pools replicate
+            from jax.sharding import PartitionSpec as _P
+
+            from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
+
+            pool_spec = _P(None, None, None, "model")
+            scale_spec = _P(None, None, None)
+            self.pool = shard_pytree(
+                self.pool,
+                PagePool(pool_spec, pool_spec, scale_spec, scale_spec),
+                tp_mesh)
         self.allocator = make_allocator(engine_cfg.num_pages,
                                         engine_cfg.native)
         self.prefix_cache = (PrefixCache(self.allocator, self.page_size)
@@ -682,22 +717,28 @@ class PagedInferenceEngine(EngineBase):
         else:
             self._prefill = jax.jit(
                 functools.partial(paged_prefill,
-                                  use_flash=flash_prefill_safe(params)),
+                                  use_flash=flash_prefill_safe(params),
+                                  ep_mesh=ep_mesh),
                 static_argnums=0, donate_argnums=donate)
         self._prefill_batch = jax.jit(
             functools.partial(paged_prefill_batch,
-                              use_flash=flash_prefill_safe(params)),
+                              use_flash=flash_prefill_safe(params),
+                              ep_mesh=ep_mesh),
             static_argnums=0, donate_argnums=donate)
-        self._prefill_chunk = jax.jit(paged_prefill_chunk, static_argnums=0,
-                                      donate_argnums=donate)
+        self._prefill_chunk = jax.jit(
+            functools.partial(paged_prefill_chunk, ep_mesh=ep_mesh),
+            static_argnums=0, donate_argnums=donate)
         self._decode = jax.jit(
-            paged_decode_step, static_argnums=(0,),
+            functools.partial(paged_decode_step, ep_mesh=ep_mesh),
+            static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_scan = jax.jit(
-            paged_decode_scan, static_argnums=(0, 7, 8, 9),
+            functools.partial(paged_decode_scan, ep_mesh=ep_mesh),
+            static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))
-        self._decode_multi = jax.jit(paged_decode_multi, static_argnums=0,
-                                     donate_argnums=donate)
+        self._decode_multi = jax.jit(
+            functools.partial(paged_decode_multi, ep_mesh=ep_mesh),
+            static_argnums=0, donate_argnums=donate)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
 
@@ -904,6 +945,13 @@ class PagedInferenceEngine(EngineBase):
         for req in itertools.islice(self._pending, 1, None):
             if (len(group) >= cap
                     or self._bucket(len(req.prompt_ids)) != b0):
+                break
+            # a member with a cached prefix must not be batch-prefilled
+            # (the batch path would redundantly prefill + allocate its
+            # whole prompt); end the group so it admits singly — through
+            # the chunked prefill with KV reuse — next iteration
+            if self.prefix_cache is not None \
+                    and self.prefix_cache.has_prefix(req.prompt_ids):
                 break
             group.append(req)
         return group, matched

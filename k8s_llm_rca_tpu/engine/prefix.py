@@ -114,6 +114,18 @@ class PrefixCache:
             self._acquire(p)
         return pages, len(pages) * P
 
+    def has_prefix(self, prompt_ids: Sequence[int]) -> bool:
+        """Cheap non-acquiring probe: would ``match`` return any pages?
+        Checks only the first page's chain digest — enough for admission
+        grouping to route prefix-hitting requests to the single-admit
+        chunked path instead of redundantly prefilling them in a batch."""
+        P = self.page_size
+        if (len(prompt_ids) - 1) // P < 1:
+            return False
+        for key in _page_keys(prompt_ids, 1, P):
+            return self._chain.get(key) is not None
+        return False
+
     def _acquire(self, page: int) -> None:
         if self._ref.get(page, 0) == 0:
             self._lru.pop(page, None)

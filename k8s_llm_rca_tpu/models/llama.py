@@ -209,8 +209,21 @@ def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
     return q, k, v
 
 
-def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
+         ep_mesh=None) -> jnp.ndarray:
+    """``ep_mesh``: optional Mesh with an "expert" axis — the MoE block then
+    dispatches through the all-to-all expert-parallel path
+    (parallel/moe.expert_parallel_moe) instead of the dense soft-dispatch.
+    Lossless capacity (capacity_factor = n_experts) so serving under EP
+    computes the same function as the dense form; engines bind this at
+    construction (BASELINE configs[3]: Mixtral expert-parallel serving)."""
     if cfg.n_experts > 0:
+        if ep_mesh is not None:
+            from k8s_llm_rca_tpu.parallel.moe import expert_parallel_moe
+
+            return expert_parallel_moe(
+                x, layer, ep_mesh, top_k=cfg.n_experts_per_tok,
+                capacity_factor=float(cfg.n_experts))
         return _moe_mlp(cfg, layer, x)
     gate = jax.nn.silu(x @ dq(layer["w_gate"]))
     up = x @ dq(layer["w_up"])
@@ -243,7 +256,7 @@ def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
-                   attention_fn=None):
+                   attention_fn=None, ep_mesh=None):
     """One transformer block over a full sequence.  ``attention_fn``
     defaults to masked causal attention (always safe: differentiable for
     training, GSPMD-partitionable for TP); inference prefill passes the
@@ -259,7 +272,7 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
     b, s, _, _ = attn.shape
     x = x + attn.reshape(b, s, cfg.q_dim) @ dq(layer["wo"])
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-    x = x + _mlp(cfg, layer, h)
+    x = x + _mlp(cfg, layer, h, ep_mesh)
     return x, k, v
 
 
@@ -327,7 +340,8 @@ def _logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
-            seq_lens: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+            seq_lens: Optional[jnp.ndarray] = None,
+            ep_mesh=None) -> jnp.ndarray:
     """Training/scoring forward: tokens [B, S] -> logits [B, S, V] (fp32)."""
     b, s = tokens.shape
     if seq_lens is None:
@@ -336,12 +350,14 @@ def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
     for layer in params["layers"]:
-        x, _, _ = _block_prefill(cfg, layer, x, angles, positions, seq_lens)
+        x, _, _ = _block_prefill(cfg, layer, x, angles, positions, seq_lens,
+                                 ep_mesh=ep_mesh)
     return _logits(cfg, params, x)
 
 
 def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
-               length: jnp.ndarray, use_flash: bool = False
+               length: jnp.ndarray, use_flash: bool = False,
+               ep_mesh=None
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared prefill compute for both cache designs (contiguous slot write
     below, page scatter in engine/paged.py): run the stack over ONE
@@ -374,7 +390,7 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     ks, vs = [], []
     for layer in params["layers"]:
         x, k, v = _block_prefill(cfg, layer, x, angles, positions, seq_lens,
-                                 attention_fn)
+                                 attention_fn, ep_mesh)
         ks.append(k[0])  # [S_pad, n_kv, d]
         vs.append(v[0])
 
@@ -385,7 +401,8 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
             tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
-            use_flash: bool = False) -> Tuple[KVCache, jnp.ndarray]:
+            use_flash: bool = False, ep_mesh=None
+            ) -> Tuple[KVCache, jnp.ndarray]:
     """Prefill ONE sequence into cache slot ``slot``.
 
     tokens [1, S_pad] right-padded; ``length`` scalar valid length; returns
@@ -393,7 +410,8 @@ def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
     (engine/engine.py buckets prompt lengths to keep recompiles bounded).
     ``use_flash``: see prefill_kv.
     """
-    new_k, new_v, logits = prefill_kv(cfg, params, tokens, length, use_flash)
+    new_k, new_v, logits = prefill_kv(cfg, params, tokens, length, use_flash,
+                                      ep_mesh)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 
@@ -438,7 +456,7 @@ def _store_layer_kv(cache: KVCache, li: int, k_new: jnp.ndarray,
 
 
 def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
-                tokens: jnp.ndarray, lengths: jnp.ndarray
+                tokens: jnp.ndarray, lengths: jnp.ndarray, ep_mesh=None
                 ) -> Tuple[KVCache, jnp.ndarray]:
     """One decode step for ALL slots (continuous batching inner loop).
 
@@ -474,7 +492,7 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
             lengths + 1)
         x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, layer, hm)
+        x = x + _mlp(cfg, layer, hm, ep_mesh)
 
     cache = KVCache(
         jnp.stack(new_ks), jnp.stack(new_vs),
@@ -504,7 +522,7 @@ def _write_tokens_scale(scale_layer: jnp.ndarray, s_new: jnp.ndarray,
 
 
 def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
-                 tokens: jnp.ndarray, lengths: jnp.ndarray
+                 tokens: jnp.ndarray, lengths: jnp.ndarray, ep_mesh=None
                  ) -> Tuple[KVCache, jnp.ndarray]:
     """Multi-token decode step (speculative verification).
 
@@ -546,7 +564,7 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
             lengths + 1)
         x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, layer, hm)
+        x = x + _mlp(cfg, layer, hm, ep_mesh)
 
     cache = KVCache(
         jnp.stack(new_ks), jnp.stack(new_vs),
@@ -619,7 +637,8 @@ def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
 
 
 def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
-                      lengths: jnp.ndarray, use_flash: bool = False
+                      lengths: jnp.ndarray, use_flash: bool = False,
+                      ep_mesh=None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched prefill forward WITHOUT a cache write: tokens [N, S_pad]
     right-padded, lengths [N] -> (new_k [L, N, S_pad, kv_dim], new_v,
@@ -640,7 +659,7 @@ def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     ks, vs = [], []
     for layer in params["layers"]:
         x, k, v = _block_prefill(cfg, layer, x, angles, positions, lengths,
-                                 attention_fn)
+                                 attention_fn, ep_mesh)
         ks.append(k.reshape(n, s_pad, cfg.kv_dim))   # [N, S_pad, kv]
         vs.append(v.reshape(n, s_pad, cfg.kv_dim))
 
@@ -652,7 +671,7 @@ def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
                   tokens: jnp.ndarray, lengths: jnp.ndarray,
-                  slots: jnp.ndarray, use_flash: bool = False
+                  slots: jnp.ndarray, use_flash: bool = False, ep_mesh=None
                   ) -> Tuple[KVCache, jnp.ndarray]:
     """Prefill N sequences into their cache slots in ONE dispatch.
 
@@ -665,7 +684,7 @@ def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
     """
     _, s_pad = tokens.shape
     new_k, new_v, logits = _prefill_batch_kv(cfg, params, tokens, lengths,
-                                             use_flash)
+                                             use_flash, ep_mesh)
     if cache.quantized:
         packed = _kv_packed(cfg, cache)
         new_k, k_s = _quantize_kv(new_k, packed)     # scales [L, N, S_pad]

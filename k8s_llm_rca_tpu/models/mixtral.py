@@ -1,12 +1,14 @@
-"""Mixtral-family sparse-MoE decoder LM.
+"""Mixtral-family sparse-MoE decoder LM + expert-parallel serving assembly.
 
 Architecturally this is the Llama stack with the MLP swapped for a
-top-k-routed expert block, so the implementation lives in models/llama.py
-(``n_experts > 0`` switches the block; see ``llama._moe_mlp`` for the dense
-soft-dispatch formulation and parallel/moe.py for the expert-parallel
-all-to-all dispatch used under an "expert" mesh axis).  This module is the
-family's named entry point: presets plus re-exported entry points, so model
-code reads ``from k8s_llm_rca_tpu.models import mixtral``.
+top-k-routed expert block, so the block implementation lives in
+models/llama.py (``n_experts > 0`` switches it; ``llama._moe_mlp`` is the
+dense soft-dispatch form, parallel/moe.py the all-to-all EP dispatch).
+What lives HERE is what is Mixtral-specific: the presets and the
+**expert-parallel serving assembly** — building the (data, expert) mesh,
+sharding the stacked expert weights over it, and constructing an engine
+whose every MoE MLP (prefill and decode) dispatches through the
+all-to-all path.
 
 Replaces the reference's remote GPT-4 (its only model access is the HTTPS
 client, reference common/openai_generic_assistant.py:45-51) with the MoE
@@ -15,7 +17,13 @@ assistant of BASELINE config[3] (Mixtral-8x7B expert-parallel on v5e-16).
 
 from __future__ import annotations
 
-from k8s_llm_rca_tpu.config import MIXTRAL_8X7B, TINY_MOE  # noqa: F401
+from typing import Optional, Sequence
+
+import jax
+
+from k8s_llm_rca_tpu.config import (  # noqa: F401
+    MIXTRAL_8X7B, TINY_MOE, EngineConfig, MeshConfig, ModelConfig,
+)
 from k8s_llm_rca_tpu.models.llama import (  # noqa: F401
     KVCache,
     decode_step,
@@ -24,3 +32,47 @@ from k8s_llm_rca_tpu.models.llama import (  # noqa: F401
     init_params,
     prefill,
 )
+
+
+def build_ep_mesh(n_expert_shards: int, n_data: int = 1,
+                  devices: Optional[Sequence] = None):
+    """(data, expert) mesh for EP serving; ``n_expert_shards`` devices hold
+    disjoint expert subsets, ``n_data`` replicas shard the token batch."""
+    from k8s_llm_rca_tpu.runtime.mesh import build_mesh
+
+    return build_mesh(MeshConfig(data=n_data, expert=n_expert_shards),
+                      devices=devices)
+
+
+def shard_params_ep(cfg: ModelConfig, params, mesh):
+    """Stacked expert weights [E, ...] over the "expert" axis, everything
+    else replicated/TP per runtime.sharding.llama_param_specs."""
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+
+    return shard_pytree(params, llama_param_specs(cfg), mesh)
+
+
+def make_ep_engine(cfg: ModelConfig, engine_cfg: EngineConfig, params,
+                   tokenizer, n_expert_shards: Optional[int] = None,
+                   n_data: int = 1, devices: Optional[Sequence] = None,
+                   mesh=None, **engine_kw):
+    """Expert-parallel serving engine (BASELINE configs[3]).
+
+    Builds the (data, expert) mesh (or takes ``mesh``), shards ``params``
+    over it, and returns an engine (paged when ``engine_cfg.paged``) whose
+    MoE MLPs run the all-to-all dispatch on every prefill and decode step.
+    ``n_expert_shards`` defaults to all local devices.
+    """
+    from k8s_llm_rca_tpu.engine import make_engine
+
+    if cfg.n_experts <= 0:
+        raise ValueError(f"{cfg.name} is not an MoE config")
+    if mesh is None:
+        if n_expert_shards is None:
+            n_expert_shards = len(devices or jax.devices()) // n_data
+        mesh = build_ep_mesh(n_expert_shards, n_data, devices)
+    sharded = shard_params_ep(cfg, params, mesh)
+    return make_engine(cfg, engine_cfg, sharded, tokenizer, ep_mesh=mesh,
+                       **engine_kw)

@@ -104,6 +104,10 @@ def quantize(w: jnp.ndarray, axis=-1,
     reduce_axes = tuple(i for i in range(w.ndim) if i not in keep)
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
                    keepdims=True)
+    # symmetric convention: int4 uses [-7, 7] and deliberately never emits
+    # the -8 code point — a zero-centered codebook keeps dequant exactly
+    # sign-symmetric (matching llama._quantize_kv), at the cost of one of
+    # the 16 levels; the asymmetric amax/7.5 variant buys <1% extra SNR
     qmax = 127.0 if bits == 8 else 7.0
     scale = jnp.where(amax > 0, amax / qmax, 1.0)
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax)

@@ -16,6 +16,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import csv
 import json
 import os
@@ -105,6 +106,11 @@ def main(argv=None) -> dict:
                         help="incident slice lo:hi")
     parser.add_argument("--resume", action="store_true",
                         help="skip incidents already present in --output")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="data-parallel serving: N pipeline replicas "
+                             "(engine replicas pinned round-robin to local "
+                             "devices) drain one incident queue "
+                             "(BASELINE configs[2] pod-sweep shape)")
     args = parser.parse_args(argv)
 
     if not os.path.exists(args.input):
@@ -119,30 +125,14 @@ def main(argv=None) -> dict:
         log.info("resuming: %d incidents already in %s", skip, args.output)
         messages = messages[skip:]
 
-    service = build_service(args)
-    meta, state = build_executors(args)
-    pipeline = RCAPipeline(
-        service, meta, state, RCAConfig(model=args.model),
-        sweep=SweepConfig(input_csv=args.input, output_json=args.output))
-
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
     start = time.time()
-    costs = []
-    failures = 0
-    for message in messages:
-        t0 = time.time()
-        try:
-            result = pipeline.analyze_incident(message)
-        except Exception as e:          # a failed incident must not kill the
-            failures += 1               # sweep; the record keeps it resumable
-            log.warning("incident failed: %s", e)
-            result = {"error_message": message, "error": str(e),
-                      "time_cost": time.time() - t0}
-        costs.append(result["time_cost"])
-        with open(args.output, "a") as f:
-            f.write(json.dumps(result, indent=4) + "\n")
-        log.info("incident done in %.2fs -> %s", result["time_cost"],
-                 args.output)
+    n_rep = max(1, args.replicas)
+    if n_rep == 1:
+        costs, failures, per_replica = _drain_serial(args, messages)
+    else:
+        costs, failures, per_replica = _drain_replicated(args, messages,
+                                                         n_rep)
     elapsed = time.time() - start
 
     summary = {
@@ -153,10 +143,112 @@ def main(argv=None) -> dict:
         "metrics": METRICS.snapshot(),
         "chip": chip_metrics(elapsed),
     }
+    if per_replica is not None:
+        summary["replicas"] = per_replica
     print(json.dumps({k: v for k, v in summary.items() if k != "metrics"}))
-    meta.close()
-    state.close()
     return summary
+
+
+def _build_pipeline(args):
+    service = build_service(args)
+    meta, state = build_executors(args)
+    return RCAPipeline(
+        service, meta, state, RCAConfig(model=args.model),
+        sweep=SweepConfig(input_csv=args.input, output_json=args.output))
+
+
+def _run_one(pipeline, message, output_path, lock=None):
+    t0 = time.time()
+    try:
+        result = pipeline.analyze_incident(message)
+        failed = False
+    except Exception as e:              # a failed incident must not kill the
+        log.warning("incident failed: %s", e)   # sweep; the record keeps it
+        result = {"error_message": message, "error": str(e),   # resumable
+                  "time_cost": time.time() - t0}
+        failed = True
+    ctx = lock if lock is not None else contextlib.nullcontext()
+    with ctx:
+        with open(output_path, "a") as f:
+            f.write(json.dumps(result, indent=4) + "\n")
+    log.info("incident done in %.2fs -> %s", result["time_cost"],
+             output_path)
+    return result["time_cost"], failed
+
+
+def _drain_serial(args, messages):
+    pipeline = _build_pipeline(args)
+    costs, failures = [], 0
+    for message in messages:
+        cost, failed = _run_one(pipeline, message, args.output)
+        costs.append(cost)
+        failures += failed
+    pipeline.meta_executor.close()
+    pipeline.state_executor.close()
+    return costs, failures, None
+
+
+def _drain_replicated(args, messages, n_rep):
+    """Data-parallel sweep serving: ``n_rep`` full pipeline replicas — each
+    with its OWN assistants and (for --backend engine) its own engine whose
+    arrays live on a round-robin-pinned local device — drain one shared
+    incident queue.  This is the single-host shape of BASELINE configs[2]
+    (a 100-incident sweep across a pod: one replica per chip, DP over
+    incidents); multi-host runs launch one process per host with a slice.
+    """
+    import queue
+    import threading
+
+    work: "queue.Queue[str]" = queue.Queue()
+    for m in messages:
+        work.put(m)
+    lock = threading.Lock()
+    costs, failures, per_replica = [], [0], []
+
+    devices = None
+    if args.backend == "engine":
+        import jax
+
+        devices = jax.devices()
+
+    def drain(idx: int) -> None:
+        dev = devices[idx % len(devices)] if devices else None
+        ctx = (jax.default_device(dev) if dev is not None
+               else contextlib.nullcontext())
+        with ctx:                      # engine arrays land on this device
+            try:
+                pipeline = _build_pipeline(args)
+            except Exception as e:     # surface, don't die silently: the
+                log.exception("replica %d failed to build", idx)   # queue
+                with lock:             # drains through the other replicas
+                    per_replica.append({"replica": idx, "incidents": 0,
+                                        "error": str(e)})
+                return
+            count = 0
+            while True:
+                try:
+                    message = work.get_nowait()
+                except queue.Empty:
+                    break
+                cost, failed = _run_one(pipeline, message, args.output, lock)
+                with lock:
+                    costs.append(cost)
+                    failures[0] += failed
+                count += 1
+        with lock:
+            per_replica.append({"replica": idx, "incidents": count,
+                                "device": str(dev) if dev else "host"})
+        pipeline.meta_executor.close()
+        pipeline.state_executor.close()
+
+    threads = [threading.Thread(target=drain, args=(i,), daemon=True)
+               for i in range(n_rep)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    per_replica.sort(key=lambda r: r["replica"])
+    return costs, failures[0], per_replica
 
 
 if __name__ == "__main__":

@@ -10,6 +10,7 @@ engine-side counters (tokens, steps, queue depth) that the sweep drivers and
 from __future__ import annotations
 
 import contextlib
+import threading
 import logging
 import time
 from collections import defaultdict
@@ -32,13 +33,19 @@ def get_logger(name: str = "k8s_llm_rca_tpu") -> logging.Logger:
 
 @dataclass
 class Metrics:
-    """Process-local counters + phase timers."""
+    """Process-local counters + phase timers.
+
+    Mutations take a lock: the DP sweep (sweeps/run_file.py --replicas)
+    drives this global from N replica threads, and ``counters[name] +=``
+    is a read-modify-write that loses increments under a thread switch."""
 
     counters: Dict[str, float] = field(default_factory=lambda: defaultdict(float))
     timings: Dict[str, List[float]] = field(default_factory=lambda: defaultdict(list))
+    _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def inc(self, name: str, value: float = 1.0) -> None:
-        self.counters[name] += value
+        with self._lock:
+            self.counters[name] += value
 
     @contextlib.contextmanager
     def timer(self, name: str):
@@ -46,7 +53,9 @@ class Metrics:
         try:
             yield
         finally:
-            self.timings[name].append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.timings[name].append(dt)
 
     def count(self, name: str) -> float:
         """Current value of an ``inc`` counter (0 if never incremented)."""

@@ -286,6 +286,36 @@ class TestPrefixCaching:
         a.check()
         assert a.n_free == 15                 # everything back in the pool
 
+    def test_admission_group_breaks_at_member_prefix_hit(self):
+        """A batched-admission group must END before a member whose prompt
+        already has cached prefix pages: batch-prefilling it would redo the
+        cached work and allocate fresh pages for it (ADVICE r1).  The member
+        must instead admit singly through the chunked path with a hit."""
+        from k8s_llm_rca_tpu.utils.logging import METRICS
+
+        eng, tok, _, _ = self._engine(max_batch=8)
+        shared = tok.encode("kubelet failed to mount the configmap volume "
+                            "for pod api-0", add_bos=True)
+        assert len(shared) > 16
+        # seed the cache, then drain
+        eng.generate([list(shared)], max_new_tokens=4)
+        base_hits = METRICS.counters.get("engine.prefix_hit_tokens", 0)
+        # burst: a cold head + a prefix-hitting member + another cold one
+        cold1 = tok.encode("node pressure eviction started on worker-3 xx",
+                           add_bos=True)
+        cold2 = tok.encode("pvc stuck pending storageclass missing here yy",
+                           add_bos=True)
+        ids = [eng.submit(list(cold1), max_new_tokens=4),
+               eng.submit(list(shared), max_new_tokens=4),
+               eng.submit(list(cold2), max_new_tokens=4)]
+        results = {r.seq_id: r for r in eng.run_to_completion()}
+        assert all(results[i].completion_tokens == 4 for i in ids)
+        # the shared-prefix member went through the single-admit chunked
+        # path and recorded its hit
+        assert METRICS.counters.get("engine.prefix_hit_tokens", 0) \
+            > base_hits
+        eng.allocator.check()
+
     def test_second_submit_skips_cached_prefill(self):
         from k8s_llm_rca_tpu.utils.logging import METRICS
 
@@ -516,10 +546,15 @@ class TestQuantizedPool:
             prompt = tok.encode("kubelet failed to mount volume for pod "
                                 "web-0 secret missing", add_bos=True)
             r1 = eng.generate([list(prompt)], max_new_tokens=6)[0]
+            hits_before = METRICS.counters.get("engine.prefix_hit_tokens", 0)
             r2 = eng.generate([list(prompt)], max_new_tokens=6)[0]
             assert r1.completion_tokens == 6, kv_dtype
             assert r2.completion_tokens == 6, kv_dtype
-            assert METRICS.counters.get("engine.prefix_hit_tokens", 0) > 0
+            # strictly increased across THIS resubmit (the counter is
+            # process-global; an absolute >0 check could pass on earlier
+            # tests' hits)
+            assert METRICS.counters.get("engine.prefix_hit_tokens", 0) \
+                > hits_before, kv_dtype
             eng.allocator.check()
 
     def test_engine_scan_and_speculative_ticks(self):

@@ -434,3 +434,202 @@ def test_ep_sharded_engine_matches_unsharded(cpu_devices):
     got = make_engine(cfg, ecfg, sharded, tok).generate(
         [list(prompts[0])], max_new_tokens=6)
     assert ref[0].token_ids == got[0].token_ids
+
+
+def test_ep_engine_matches_dense(cpu_devices):
+    """Serving EP (VERDICT r1 item 4): an engine built with an expert-axis
+    mesh — every MoE MLP dispatching through the all-to-all path, prefill
+    AND decode — must emit the same greedy tokens as the dense
+    soft-dispatch engine (lossless capacity)."""
+    from k8s_llm_rca_tpu.config import TINY_MOE, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.models import mixtral
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY_MOE.replace(max_seq_len=64, n_experts=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=4, max_seq_len=64,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True),
+               tok.encode("secret missing for mount", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok).generate(
+        prompts, max_new_tokens=6)
+    ep_engine = mixtral.make_ep_engine(
+        cfg, ecfg, params, tok, n_expert_shards=4, n_data=1,
+        devices=cpu_devices[:4])
+    got = ep_engine.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+        assert r.finish_reason == g.finish_reason
+
+
+def test_ep_paged_engine_matches_dense(cpu_devices):
+    """EP x paged: the paged engine under an expert mesh (page-scatter
+    writes + all-to-all MoE) matches the dense paged engine."""
+    from k8s_llm_rca_tpu.config import TINY_MOE, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.models import mixtral
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY_MOE.replace(max_seq_len=64, n_experts=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=4, max_seq_len=64, paged=True,
+                        page_size=8, num_pages=48,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("node notready kubelet stopped", add_bos=True),
+               tok.encode("image pull backoff", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok, use_kernel=False).generate(
+        prompts, max_new_tokens=6)
+    ep_engine = mixtral.make_ep_engine(
+        cfg, ecfg, params, tok, n_expert_shards=4, n_data=1,
+        devices=cpu_devices[:4], use_kernel=False)
+    got = ep_engine.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+    ep_engine.allocator.check()
+
+
+def test_ep_mesh_validation():
+    """Misconfigured EP serving fails loudly at construction."""
+    from k8s_llm_rca_tpu.config import TINY, TINY_MOE, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import validate_ep_mesh
+    from k8s_llm_rca_tpu.models import mixtral
+
+    mesh = build_mesh(MeshConfig(data=1, expert=4),
+                      devices=jax.devices("cpu")[:4])
+    ecfg = EngineConfig(max_batch=4, max_seq_len=64, prefill_buckets=(16,))
+    with pytest.raises(ValueError, match="MoE model"):
+        validate_ep_mesh(mesh, TINY, ecfg, None)
+    with pytest.raises(ValueError, match="not divisible"):
+        validate_ep_mesh(mesh, TINY_MOE.replace(n_experts=4),
+                         EngineConfig(max_batch=3, max_seq_len=64,
+                                      prefill_buckets=(16,)), None)
+    with pytest.raises(ValueError, match="n_experts"):
+        validate_ep_mesh(mesh, TINY_MOE.replace(n_experts=3), ecfg, None)
+    with pytest.raises(ValueError, match="not an MoE"):
+        mixtral.make_ep_engine(TINY, ecfg, {}, None, n_expert_shards=4)
+
+
+def test_paged_tp_engine_matches_unsharded(cpu_devices):
+    """Paged serving TP (VERDICT r1 item 5): the paged engine with
+    TP-sharded params AND the page pool sharded on the merged kv axis must
+    emit the unsharded paged engine's greedy tokens."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                        page_size=8, num_pages=32,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok, use_kernel=False).generate(
+        prompts, max_new_tokens=6)
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    eng = make_engine(cfg, ecfg, sharded, tok, tp_mesh=mesh)
+    got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+        assert r.finish_reason == g.finish_reason
+    eng.allocator.check()
+    # the pool really is distributed: each device holds 1/model of kv bytes
+    shard_shape = eng.pool.k.sharding.shard_shape(eng.pool.k.shape)
+    assert shard_shape[-1] == cfg.kv_dim // 2
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+def test_paged_tp_engine_quantized_pool(cpu_devices, kv_dtype):
+    """Paged TP x quantized pool: int8/int4 pages shard on the merged kv
+    axis (int4's nibble-packed halved axis included), per-token scale
+    pools replicate, greedy tokens match the unsharded quantized engine."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                        page_size=8, num_pages=32,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0, kv_cache_dtype=kv_dtype)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("node notready kubelet stopped", add_bos=True),
+               tok.encode("image pull backoff", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok, use_kernel=False).generate(
+        prompts, max_new_tokens=6)
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    eng = make_engine(cfg, ecfg, sharded, tok, tp_mesh=mesh)
+    got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+    eng.allocator.check()
+
+
+def test_paged_tp_rejects_kernel(cpu_devices):
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                        page_size=8, num_pages=32, prefill_buckets=(16,))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="Pallas"):
+        PagedInferenceEngine(cfg, ecfg, params, get_tokenizer(),
+                             use_kernel=True, tp_mesh=mesh)
+
+
+def test_contiguous_tp_engine_cache_sharded(cpu_devices):
+    """tp_mesh on the CONTIGUOUS engine: the KV cache is placed sharded
+    (slots over data, merged kv axis over model) and greedy output still
+    matches the unsharded engine — including a quantized cache whose
+    per-token scale arrays shard on data only."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True)]
+    for kv_dtype in (None, "int8"):
+        ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                            prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                            temperature=0.0, kv_cache_dtype=kv_dtype)
+        ref = make_engine(cfg, ecfg, params, tok).generate(
+            prompts, max_new_tokens=6)
+        sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+        eng = make_engine(cfg, ecfg, sharded, tok, tp_mesh=mesh)
+        shard_shape = eng.cache.k.sharding.shard_shape(eng.cache.k.shape)
+        assert shard_shape[1] == 1                  # slots over data
+        assert shard_shape[-1] == eng.cache.k.shape[-1] // 2   # kv over model
+        got = eng.generate(prompts, max_new_tokens=6)
+        for r, g in zip(ref, got):
+            assert r.token_ids == g.token_ids, kv_dtype
