@@ -20,6 +20,7 @@ metric), and the prefill throughput.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -44,12 +45,14 @@ def pick_config():
         return TINY.replace(name="bench-tiny"), 8, 64, 128, 0
     # one chip (~16G HBM): TinyLlama-1.1B int4 ~0.6G weights; with the
     # merged-dim nibble-packed int4 KV cache (models/llama.KVCache)
-    # batch=576 at seq 1280 fits the HBM ceiling (608 compiles but is past
-    # the throughput knee), and decode is latency-bound on this chip, so
-    # throughput scales ~linearly with batch until then.  max_seq holds
-    # prompt + warmup scan + measured scan.
+    # batch=512 at seq 1280 is the safe ceiling — 576 still runs, but with
+    # the chained-prefill carry buffers it leaves the device in a faulted
+    # state for every later program in the process (the async HBM-cliff
+    # fault surfaces at the NEXT dispatch, killing the 8B and engine-p50
+    # legs), and decode is latency-bound here so 512 measures the same
+    # tok/s.  max_seq holds prompt + warmup scan + measured scan.
     cfg = MODEL_REGISTRY["tinyllama-1.1b"].replace(max_seq_len=1280)
-    return cfg, 576, 128, 512, 4
+    return cfg, 512, 128, 512, 4
 
 
 def _timed_decode_scan(cfg, params, cache, batch, prompt_len, decode_steps,
@@ -57,7 +60,14 @@ def _timed_decode_scan(cfg, params, cache, batch, prompt_len, decode_steps,
     """Warm (compile) + ONE long measured scan chained on the warmup's
     outputs.  The chain defeats the axon tunnel's memoization of identical
     executions; a long scan amortizes dispatch so the number reflects
-    steady-state decode.  Cache donated so XLA updates in place."""
+    steady-state decode.  Cache donated so XLA updates in place.
+
+    Returns (tokens_per_s, mfu): every throughput number carries its own
+    model-FLOPs-utilization cross-check against the chip's bf16 peak
+    (runtime/profiling.mfu; None off-TPU) so a tunnel-memoization artifact
+    shows up as an impossible MFU instead of a silent headline."""
+    from k8s_llm_rca_tpu.runtime import profiling
+
     cur = jnp.full((batch,), 7, jnp.int32)
     lengths = jnp.full((batch,), prompt_len, jnp.int32)
     donate = (2,) if jax.default_backend() == "tpu" else ()
@@ -72,7 +82,12 @@ def _timed_decode_scan(cfg, params, cache, batch, prompt_len, decode_steps,
                           jax.random.PRNGKey(1), decode_steps,
                           SamplingParams(), eos_id)
     toks.block_until_ready()
-    return batch * decode_steps / (time.perf_counter() - start)
+    tps = batch * decode_steps / (time.perf_counter() - start)
+    # mean KV context across the measured scan: warmup already decoded
+    # decode_steps past the prompt, the measured scan adds decode_steps more
+    ctx = prompt_len + decode_steps + decode_steps // 2
+    u = profiling.mfu(cfg, tps, ctx)
+    return tps, (round(u, 4) if u is not None else None)
 
 
 def bench_decode(cfg, batch, prompt_len, decode_steps, quant_bits=0):
@@ -94,10 +109,16 @@ def bench_decode(cfg, batch, prompt_len, decode_steps, quant_bits=0):
                       donate_argnums=donate)
 
     # prefill every slot in groups of <=64 via the engine's batched
-    # admission path (one dispatch per group); warm round compiles, timed
-    # round uses fresh prompts (identical executions would hit backend
-    # result caching)
+    # admission path (one dispatch per group); warm round compiles.  Every
+    # round is CHAINED through data dependencies — each group's prompts mix
+    # in the previous group's argmax logits — the same way the decode scan
+    # chains, so the axon tunnel cannot serve any prefill from its
+    # identical-execution memo (VERDICT r1 weak #2: the unchained loop
+    # produced a physically impossible 8.1M tok/s).
+    from k8s_llm_rca_tpu.runtime import profiling
+
     t_pref = None
+    carry = jnp.zeros((64,), jnp.int32)
     for _round in range(2):
         start = time.perf_counter()
         for lo in range(0, batch, 64):
@@ -105,17 +126,24 @@ def bench_decode(cfg, batch, prompt_len, decode_steps, quant_bits=0):
             prompts = jnp.asarray(
                 rng.integers(0, cfg.vocab_size, (group, prompt_len)),
                 jnp.int32)
+            n = min(group, int(carry.shape[0]))
+            prompts = prompts.at[:n, 0].set(
+                carry[:n] % jnp.int32(cfg.vocab_size))
             cache, logits = prefill(
                 cfg, params, cache, prompts,
                 jnp.full((group,), prompt_len, jnp.int32),
                 jnp.arange(lo, lo + group, dtype=jnp.int32))
+            carry = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits.block_until_ready()
         t_pref = time.perf_counter() - start
     prefill_tps = batch * prompt_len / t_pref
+    # prefill FLOPs/token ~= decode FLOPs at the mean causal context S/2
+    pre_mfu = profiling.mfu(cfg, prefill_tps, prompt_len // 2)
 
-    decode_tps = _timed_decode_scan(cfg, params, cache, batch, prompt_len,
-                                    decode_steps, tok.eos_id)
-    return decode_tps, prefill_tps
+    decode_tps, decode_mfu = _timed_decode_scan(
+        cfg, params, cache, batch, prompt_len, decode_steps, tok.eos_id)
+    return (decode_tps, decode_mfu, prefill_tps,
+            round(pre_mfu, 4) if pre_mfu is not None else None)
 
 
 def bench_8b():
@@ -134,13 +162,14 @@ def bench_8b():
     cache = llama.init_cache(cfg, batch, cfg.max_seq_len,
                              kv_dtype="int4")
     return _timed_decode_scan(cfg, params, cache, batch, prompt_len, steps,
-                              eos_id=-1)
+                              eos_id=-1)   # (tps, mfu)
 
 
 def bench_rca_p50(n_incidents: int = 100):
-    """Hermetic 100-incident RCA sweep p50 latency (oracle backend) — the
-    BASELINE north-star workload shape (configs[2]), cycling the canned
-    incident corpus."""
+    """Hermetic 100-incident RCA sweep p50 latency with the SCRIPTED ORACLE
+    backend — no LLM decode inside the measured region, so this number is
+    graph+pipeline overhead only (the BASELINE configs[2] workload shape).
+    The LLM-inclusive latency is bench_rca_p50_engine."""
     from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
     from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS, build_metagraph, \
         build_stategraph
@@ -159,35 +188,135 @@ def bench_rca_p50(n_incidents: int = 100):
     return costs[len(costs) // 2]
 
 
-def main():
-    cfg, batch, prompt_len, decode_steps, quant_bits = pick_config()
-    decode_tps, prefill_tps = bench_decode(cfg, batch, prompt_len,
-                                           decode_steps, quant_bits)
+def bench_rca_p50_engine(n_incidents: int = 3):
+    """End-to-end RCA p50 with every LLM call decoded by the REAL engine on
+    the local accelerator (random weights: the JSON schema grammar keeps
+    stage 1 structurally valid and stage 2 falls back to the deterministic
+    compiler by design, so latency is representative while content is
+    garbage).  Through the axon tunnel each decode tick pays ~0.2-0.3 s of
+    dispatch latency, so only a few incidents with tight budgets are
+    affordable; the tick count per incident matches the real workload
+    shape (one forced-skeleton stage-1 run + capped stage-2/3 runs)."""
+    import jax as _jax
+
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+    from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS, build_metagraph, \
+        build_stategraph
+    from k8s_llm_rca_tpu.rca import RCAPipeline
+    from k8s_llm_rca_tpu.serve.api import AssistantService
+    from k8s_llm_rca_tpu.serve.backend import EngineBackend
+
+    cfg = TINY.replace(max_seq_len=4096)
+    params = llama.init_params(cfg, _jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    engine = make_engine(
+        cfg, EngineConfig(max_batch=4, max_seq_len=4096,
+                          prefill_buckets=(1024, 2048, 4096),
+                          max_new_tokens=64, temperature=0.0,
+                          # stages 2/3 carry no grammar, so their decode
+                          # amortizes 8 steps per dispatch — the tunnel's
+                          # ~0.25 s tick cost is the whole p50 story here
+                          decode_chunk=8),
+        params, tok)
+    pipeline = RCAPipeline(
+        AssistantService(EngineBackend(engine)),
+        InMemoryGraphExecutor(build_metagraph()),
+        InMemoryGraphExecutor(build_stategraph()),
+        RCAConfig(cypher_max_new_tokens=64, analyzer_max_new_tokens=64))
+    costs = sorted(
+        pipeline.analyze_incident(INCIDENTS[i % len(INCIDENTS)].message)
+        ["time_cost"] for i in range(n_incidents))
+    return costs[len(costs) // 2]
+
+
+def _leg(expr: str, timeout: int = 560):
+    """Run one bench leg in a FRESH interpreter.
+
+    Device-state isolation: a heavy leg can leave the tunnel-attached chip
+    in a faulted state that kills every LATER dispatch in the same process
+    (observed: the TinyLlama decode leg at high batch async-faults, then
+    the 8B and engine-p50 legs die with UNAVAILABLE).  One process per leg
+    makes the legs independent; they run strictly sequentially (two
+    concurrent TPU processes would fight over the chip grant)."""
+    import os
+    import subprocess
+
+    code = (f"import bench, json; "
+            f"print('LEGRESULT ' + json.dumps({expr}))")
     try:
-        p50 = bench_rca_p50()
-    except Exception:
-        p50 = None
-    tps_8b = None
-    if jax.devices()[0].platform == "tpu":
-        try:
-            tps_8b = round(bench_8b(), 2)
-        except Exception:
-            pass
-    print(json.dumps({
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"[bench] leg timed out: {expr}", file=sys.stderr)
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("LEGRESULT "):
+            return json.loads(line[len("LEGRESULT "):])
+    print(f"[bench] leg failed rc={proc.returncode}: {expr}: "
+          f"{proc.stderr[-500:]}", file=sys.stderr)
+    return None
+
+
+def bench_decode_leg():
+    """Subprocess entry: headline decode+prefill on the local chip."""
+    cfg, batch, prompt_len, decode_steps, quant_bits = pick_config()
+    tps, mfu_d, pre_tps, mfu_p = bench_decode(cfg, batch, prompt_len,
+                                              decode_steps, quant_bits)
+    dev = jax.devices()[0]
+    return [tps, mfu_d, pre_tps, mfu_p, cfg.name, batch, quant_bits,
+            str(dev), dev.platform]
+
+
+def main():
+    """Host-only aggregator: every device leg runs in its own interpreter
+    (see _leg) so this process never takes the chip grant itself."""
+    dec = _leg("bench.bench_decode_leg()")
+    if dec is None:
+        dec = [None, None, None, None, "unknown", 0, 0, "unknown", "none"]
+    (decode_tps, mfu_decode, prefill_tps, mfu_prefill,
+     model_name, batch, quant_bits, device_str, platform) = dec
+    p50_oracle = _leg("bench.bench_rca_p50()")
+    p50_engine = _leg("bench.bench_rca_p50_engine()")
+    tps_8b = mfu_8b = None
+    if platform == "tpu":
+        res = _leg("list(bench.bench_8b())")
+        if res is not None:
+            tps_8b, mfu_8b = round(res[0], 2), res[1]
+
+    # self-audit: an MFU above the chip's peak means the measurement — not
+    # the machine — is broken (tunnel memoization, async timing, ...); flag
+    # it on the line rather than publishing an impossible headline
+    mfus = [u for u in (mfu_decode, mfu_prefill, mfu_8b) if u is not None]
+    suspect = any(u > 1.0 for u in mfus)
+
+    line = {
         "metric": "decode_throughput",
-        "value": round(decode_tps, 2),
+        "value": round(decode_tps, 2) if decode_tps else None,
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(decode_tps / REFERENCE_TOKENS_PER_S, 2),
-        "model": cfg.name,
+        "vs_baseline": round(decode_tps / REFERENCE_TOKENS_PER_S, 2)
+        if decode_tps else None,
+        "model": model_name,
         "weights": f"int{quant_bits}" if quant_bits else "bf16",
         "kv_cache": "int4" if quant_bits == 4
                     else "int8" if quant_bits else "bf16",
         "batch": batch,
-        "prefill_tokens_per_s": round(prefill_tps, 2),
+        "mfu": mfu_decode,
+        "prefill_tokens_per_s": round(prefill_tps, 2) if prefill_tps
+        else None,
+        "prefill_mfu": mfu_prefill,
         "tokens_per_s_8b_int4": tps_8b,
-        "rca_p50_incident_s": round(p50, 4) if p50 is not None else None,
-        "device": str(jax.devices()[0]),
-    }))
+        "mfu_8b": mfu_8b,
+        "rca_p50_oracle_s": round(p50_oracle, 4)
+        if p50_oracle is not None else None,
+        "rca_p50_engine_s": round(p50_engine, 4)
+        if p50_engine is not None else None,
+        "device": device_str,
+    }
+    if suspect:
+        line["measurement_suspect"] = True
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

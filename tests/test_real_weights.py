@@ -1,0 +1,108 @@
+"""Real-weights readiness path (VERDICT r1 item 7).
+
+Every semantic path in this repo is otherwise validated against random
+weights or the scripted oracle — fine for mechanics, silent on whether
+real checkpoints load and produce usable stage output.  This module is the
+gated bridge: set ``K8S_RCA_WEIGHTS`` to a directory holding TinyLlama(-
+compatible) HF safetensors + tokenizer files and these tests load them
+through models/loader.py + utils/tokenizer.HFTokenizer, run one REAL
+incident end-to-end on the engine, and check the stage-1 plan names a
+kind from the metagraph vocabulary (guaranteed by the schema grammar) —
+with real weights the content should also be sensible, which is what a
+human inspects in the printed report.
+
+Skipped (not failed) when the env var is unset — the zero-egress CI image
+has no checkpoints.  Usage:
+
+    K8S_RCA_WEIGHTS=/ckpts/tinyllama-1.1b-chat \\
+        python -m pytest tests/test_real_weights.py -s
+
+The directory must contain ``*.safetensors`` (HF Llama layout) and HF
+tokenizer files (tokenizer.json or tokenizer.model).  Mirrors the
+reference's implicit dependency on a capable model (reference
+find_metapath/find_srckind_metapath_neo4j.py:20-45) — made explicit,
+local, and testable.
+"""
+
+import json
+import os
+
+import pytest
+
+WEIGHTS = os.environ.get("K8S_RCA_WEIGHTS")
+
+pytestmark = pytest.mark.skipif(
+    not WEIGHTS, reason="K8S_RCA_WEIGHTS not set (real-checkpoint test)")
+
+
+@pytest.fixture(scope="module")
+def real_stack():
+    from k8s_llm_rca_tpu.config import MODEL_REGISTRY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.models.loader import load_llama
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = MODEL_REGISTRY["tinyllama-1.1b"]
+    params = load_llama(cfg, WEIGHTS)
+    tokenizer = get_tokenizer(WEIGHTS)
+    engine = make_engine(
+        cfg,
+        EngineConfig(max_batch=4, max_seq_len=2048,
+                     prefill_buckets=(512, 1024, 2048),
+                     max_new_tokens=512, temperature=0.0),
+        params, tokenizer)
+    return cfg, engine, tokenizer
+
+
+def test_weights_load_and_decode_text(real_stack):
+    """The checkpoint loads, the HF tokenizer round-trips, and greedy
+    decode emits non-degenerate text."""
+    _, engine, tok = real_stack
+    ids = tok.encode("Kubernetes is", add_bos=True)
+    (res,) = engine.generate([ids], max_new_tokens=16)
+    text = res.text
+    assert len(res.token_ids) > 0
+    assert text.strip(), f"degenerate output: {text!r}"
+
+
+def test_real_incident_end_to_end(real_stack):
+    """One real incident through the full pipeline on real weights: the
+    stage-1 plan must name kinds from the metagraph vocabulary and the
+    incident must complete with the batch-driver schema."""
+    from k8s_llm_rca_tpu.config import RCAConfig
+    from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+    from k8s_llm_rca_tpu.graph.fixtures import (
+        INCIDENTS, build_metagraph, build_stategraph,
+    )
+    from k8s_llm_rca_tpu.rca import RCAPipeline
+    from k8s_llm_rca_tpu.rca.locator import find_native_external_kinds
+    from k8s_llm_rca_tpu.serve.api import AssistantService
+    from k8s_llm_rca_tpu.serve.backend import EngineBackend
+
+    _, engine, _ = real_stack
+    meta = InMemoryGraphExecutor(build_metagraph())
+    pipeline = RCAPipeline(
+        AssistantService(EngineBackend(engine)), meta,
+        InMemoryGraphExecutor(build_stategraph()), RCAConfig())
+
+    result = pipeline.analyze_incident(INCIDENTS[0].message)
+
+    native, external = find_native_external_kinds(meta)
+    vocabulary = set(native) | set(external)
+    # re-extract the stage-1 plan from the locator thread to inspect it
+    reply = pipeline.locator.get_last_k_message(1).data[0] \
+        .content[0].text.value
+    body = reply.split("```json\n", 1)[1].rsplit("```", 1)[0]
+    plan = json.loads(body)
+    assert plan["DestinationKind"] in vocabulary
+    assert all(r in vocabulary for r in plan["RelevantResources"])
+
+    assert result["locator_attempts"] == 1
+    assert result["time_cost"] > 0
+    for analysis in result["analysis"]:
+        for audited in analysis["statepath"]:
+            assert isinstance(audited["report"], str)
+    print("\n=== real-weights RCA report(s) ===")
+    for analysis in result["analysis"]:
+        for audited in analysis["statepath"]:
+            print(audited["report"][:2000])
