@@ -439,8 +439,8 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
 
     k_scale, v_scale = pool.k_scale, pool.v_scale
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = llama._qkv(cfg, layer, h, angles, positions)  # [B,1,·,d]
+        q, k, v = llama._decode_qkv(cfg, layer, x, angles,
+                                    positions)              # [B,1,·,d]
         # scatter this token's k/v: [B, n_kv*d] -> pool[li, page, off]
         k_tok = k[:, 0].reshape(b, cfg.kv_dim)
         v_tok = v[:, 0].reshape(b, cfg.kv_dim)
@@ -469,9 +469,8 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
             attn = decode_attention(q, k_all, v_all, lengths + 1)
         else:
             attn = attn_fn(q[:, 0], kp, vp, lengths + 1, block_tables)
-        x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
-        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + llama._mlp(cfg, layer, hm, ep_mesh)
+        x = llama._decode_finish(cfg, layer, x,
+                                 attn.reshape(b, 1, cfg.q_dim), ep_mesh)
 
     logits = llama._logits(cfg, params, x)[:, 0]
     return pool, logits
@@ -507,8 +506,8 @@ def paged_decode_multi(cfg: ModelConfig, params, pool: PagePool,
 
     k_scale, v_scale = pool.k_scale, pool.v_scale
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = llama._qkv(cfg, layer, h, angles, positions)   # [B,T,·,d]
+        q, k, v = llama._decode_qkv(cfg, layer, x, angles,
+                                    positions)               # [B,T,·,d]
         k_tok = k.reshape(b, t, cfg.kv_dim)
         v_tok = v.reshape(b, t, cfg.kv_dim)
         if pool.quantized:
@@ -530,9 +529,8 @@ def paged_decode_multi(cfg: ModelConfig, params, pool: PagePool,
             vp, v_scale[li] if pool.quantized else None, block_tables,
             cfg.n_kv_heads, cfg.head_dim, dtype, packed)
         attn = decode_attention_multi(q, k_all, v_all, lengths + 1)
-        x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
-        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + llama._mlp(cfg, layer, hm, ep_mesh)
+        x = llama._decode_finish(cfg, layer, x,
+                                 attn.reshape(b, t, cfg.q_dim), ep_mesh)
 
     logits = llama._logits(cfg, params, x)                       # [B, T, V]
     return pool, jnp.argmax(logits, axis=-1), logits

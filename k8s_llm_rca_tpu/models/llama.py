@@ -276,6 +276,26 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
     return x, k, v
 
 
+def _decode_qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
+                angles: jnp.ndarray, positions: jnp.ndarray):
+    """Decode-block front half: pre-attention norm + roped q/k/v.  Shared
+    by the contiguous, paged and pipeline-parallel decode paths so the
+    block semantics cannot drift apart."""
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    return _qkv(cfg, layer, h, angles, positions)
+
+
+def _decode_finish(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
+                   attn: jnp.ndarray, ep_mesh=None) -> jnp.ndarray:
+    """Decode-block back half: attention output projection + residual +
+    MLP (shared across decode paths, see _decode_qkv).  ``attn`` must
+    already be flattened to [B, T, q_dim] — kernel outputs vary in rank,
+    so call sites own the reshape."""
+    x = x + attn @ dq(layer["wo"])
+    hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    return x + _mlp(cfg, layer, hm, ep_mesh)
+
+
 def _quantize_kv(kv: jnp.ndarray, packed: bool = False
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-token int8 (or nibble-packed int4 when ``packed``): kv
@@ -474,8 +494,7 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
     packed = _kv_packed(cfg, cache)
     new_ks, new_vs, new_kss, new_vss = [], [], [], []
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(cfg, layer, h, angles, positions)   # q [B,1,h,d]
+        q, k, v = _decode_qkv(cfg, layer, x, angles, positions)  # q [B,1,h,d]
         k_cache, v_cache, k_s, v_s = _store_layer_kv(
             cache, li, k[:, 0].reshape(b, cfg.kv_dim),
             v[:, 0].reshape(b, cfg.kv_dim), lengths)
@@ -490,9 +509,8 @@ def decode_step(cfg: ModelConfig, params: Params, cache: KVCache,
             _dequant_layer(v_cache, v_s, dtype, packed).reshape(
                 b, s_max, cfg.n_kv_heads, cfg.head_dim),
             lengths + 1)
-        x = x + attn.reshape(b, 1, cfg.q_dim) @ dq(layer["wo"])
-        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, layer, hm, ep_mesh)
+        x = _decode_finish(cfg, layer, x,
+                           attn.reshape(b, 1, cfg.q_dim), ep_mesh)
 
     cache = KVCache(
         jnp.stack(new_ks), jnp.stack(new_vs),
@@ -546,8 +564,7 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
     packed = _kv_packed(cfg, cache)
     new_ks, new_vs, new_kss, new_vss = [], [], [], []
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(cfg, layer, h, angles, positions)        # [B,T,·,d]
+        q, k, v = _decode_qkv(cfg, layer, x, angles, positions)  # [B,T,·,d]
         k_cache, v_cache, k_s, v_s = _store_layer_kv(
             cache, li, k.reshape(b, t, cfg.kv_dim),
             v.reshape(b, t, cfg.kv_dim), lengths)
@@ -562,9 +579,8 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
             _dequant_layer(v_cache, v_s, dtype, packed).reshape(
                 b, s_max, cfg.n_kv_heads, cfg.head_dim),
             lengths + 1)
-        x = x + attn.reshape(b, t, cfg.q_dim) @ dq(layer["wo"])
-        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, layer, hm, ep_mesh)
+        x = _decode_finish(cfg, layer, x,
+                           attn.reshape(b, t, cfg.q_dim), ep_mesh)
 
     cache = KVCache(
         jnp.stack(new_ks), jnp.stack(new_vs),

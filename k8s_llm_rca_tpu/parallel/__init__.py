@@ -1,6 +1,7 @@
 from k8s_llm_rca_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 from k8s_llm_rca_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
 from k8s_llm_rca_tpu.parallel.pipeline import (  # noqa: F401
-    llama_pipeline_forward, pipeline_apply, stack_llama_stages,
+    kv_cache_stage_specs, llama_pipeline_forward, llama_pp_decode_step,
+    llama_pp_prefill, pipeline_apply, stack_llama_stages,
 )
 from k8s_llm_rca_tpu.parallel.moe import expert_parallel_moe  # noqa: F401

@@ -137,3 +137,257 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         out_specs=P(*(None,) * x_mb.ndim),
         check_vma=False,
     )(stacked_params, x_mb)
+
+
+# ---------------------------------------------------------------------------
+# PP serving: pipelined prefill + per-stage KV decode
+# ---------------------------------------------------------------------------
+#
+# What makes PP serve-capable is the CACHE split, not just the weights:
+# stage i holds only its layers' weights AND its layers' KV (the KVCache
+# layer axis shards over "stage"), so a model whose weights+cache exceed
+# one device serves across the stage axis — the DCN-friendly scale-out the
+# reference cannot express at all (SURVEY §2.2 PP row).  Both entry points
+# run the GPipe microbatch schedule of ``_pipeline_local``: at tick t,
+# stage s processes microbatch t-s; activations hop stages via ppermute;
+# cache writes are masked to valid (stage, tick) pairs.  Decode pipelines
+# the BATCH (slot groups are the microbatches), so all stages stay busy in
+# steady state after the P-1 bubble.
+#
+# Scope: full-precision KV only (quantized per-stage scales would need the
+# same masked-write plumbing per scale pool); engines integrate TP/EP/DP
+# first — these entry points are the building blocks and the parity proof.
+
+
+def kv_cache_stage_specs() -> P:
+    """KVCache k/v [L, B, S, kv]: the LAYER axis shards over "stage"."""
+    return P("stage", None, None, None)
+
+
+def _stage_local_init(stage_layers, axis_name: str):
+    n_stages = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], stage_layers)   # strip stage dim
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    return n_stages, my, params, perm
+
+
+def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
+                     microbatches: int = None, stage_axis: str = "stage",
+                     stacked_layers=None):
+    """Pipeline-parallel batched prefill with per-stage KV writes.
+
+    tokens [B, S_pad] right-padded, lengths [B]; B divides into
+    ``microbatches`` slot groups (default: one per stage).  Returns
+    (cache', logits [B, V] at each row's last valid token), matching
+    ``llama.prefill_batch`` with slots = arange(B).
+    """
+    from k8s_llm_rca_tpu.models import llama as L
+
+    assert cache.k_scale is None, "PP serving supports full-precision KV"
+    n_stages = mesh.shape[stage_axis]
+    m = microbatches or n_stages
+    b, s_pad = tokens.shape
+    assert b % m == 0, (b, m)
+    bm = b // m
+    assert cfg.n_layers % n_stages == 0
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+
+    x = L.gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+    h_dim = x.shape[-1]
+    x_mb = x.reshape(m, bm, s_pad, h_dim)
+    lengths_mb = lengths.reshape(m, bm)
+    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def local(stage_layers, k_c, v_c, x_mb, lengths_mb):
+        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
+        positions = jnp.broadcast_to(jnp.arange(s_pad)[None, :], (bm, s_pad))
+
+        def stage_apply(h, mb_idx, valid, k_c, v_c):
+            seq_lens = lengths_mb[mb_idx]
+
+            def body(carry, xs):
+                layer, k_li, v_li = xs
+                h2, k, v = L._block_prefill(cfg, layer, carry, angles,
+                                            positions, seq_lens)
+                # row-granular garbage-tick masking (see decode stage_apply)
+                orig_k = jax.lax.dynamic_slice(
+                    k_li, (mb_idx * bm, 0, 0), (bm, s_pad, cfg.kv_dim))
+                orig_v = jax.lax.dynamic_slice(
+                    v_li, (mb_idx * bm, 0, 0), (bm, s_pad, cfg.kv_dim))
+                k_li = jax.lax.dynamic_update_slice(
+                    k_li, jnp.where(
+                        valid,
+                        k.reshape(bm, s_pad, cfg.kv_dim).astype(k_li.dtype),
+                        orig_k),
+                    (mb_idx * bm, 0, 0))
+                v_li = jax.lax.dynamic_update_slice(
+                    v_li, jnp.where(
+                        valid,
+                        v.reshape(bm, s_pad, cfg.kv_dim).astype(v_li.dtype),
+                        orig_v),
+                    (mb_idx * bm, 0, 0))
+                return h2, (k_li, v_li)
+
+            h, (k_new, v_new) = jax.lax.scan(body, h, (layers, k_c, v_c))
+            return h, k_new, v_new
+
+        ticks = m + n_st - 1
+        out_buf = jnp.zeros((m, bm, s_pad, h_dim), x_mb.dtype)
+        cur = jnp.zeros((bm, s_pad, h_dim), x_mb.dtype)
+
+        def tick(t, carry):
+            cur, out_buf, k_c, v_c = carry
+            mb = jnp.clip(t - my, 0, m - 1)
+            valid = jnp.logical_and(t - my >= 0, t - my < m)
+            feed = x_mb[jnp.minimum(t, m - 1)]
+            h_in = jnp.where(my == 0, feed, cur)
+            h_out, k_c, v_c = stage_apply(h_in, mb, valid, k_c, v_c)
+            mb_done = t - (n_st - 1)
+            write = jnp.logical_and(my == n_st - 1, mb_done >= 0)
+            out_buf = jax.lax.cond(
+                write,
+                lambda buf: jax.lax.dynamic_update_index_in_dim(
+                    buf, h_out, jnp.maximum(mb_done, 0), 0),
+                lambda buf: buf, out_buf)
+            cur = jax.lax.ppermute(h_out, stage_axis, perm)
+            return cur, out_buf, k_c, v_c
+
+        cur, out_buf, k_c, v_c = jax.lax.fori_loop(
+            0, ticks, tick, (cur, out_buf, k_c, v_c))
+        contrib = jnp.where(my == n_st - 1, out_buf, jnp.zeros_like(out_buf))
+        return jax.lax.psum(contrib, stage_axis), k_c, v_c
+
+    out, k_new, v_new = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), kv_cache_stage_specs(),
+                  kv_cache_stage_specs(), P(*(None,) * 4), P(None, None)),
+        out_specs=(P(*(None,) * 4), kv_cache_stage_specs(),
+                   kv_cache_stage_specs()),
+        check_vma=False,
+    )(stacked, cache.k, cache.v, x_mb, lengths_mb)
+
+    x_final = out.reshape(b, s_pad, h_dim)
+    last = x_final[jnp.arange(b), lengths - 1][:, None]
+    logits = L._logits(cfg, params, last)[:, 0]
+    return type(cache)(k_new, v_new), logits
+
+
+def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
+                         microbatches: int = None,
+                         stage_axis: str = "stage", stacked_layers=None):
+    """One pipeline-parallel decode step for ALL slots.
+
+    tokens [B] current token per slot, lengths [B] cached tokens; the B
+    slots split into ``microbatches`` groups that flow through the stages
+    GPipe-style (steady-state keeps every stage busy).  Returns (cache',
+    logits [B, V]) matching ``llama.decode_step``.
+
+    Hot paths MUST hoist ``stack_llama_stages`` once and pass
+    ``stacked_layers``: the default restacks every layer's weights (a
+    full-model copy) on every call.
+    """
+    from k8s_llm_rca_tpu.models import llama as L
+    from k8s_llm_rca_tpu.ops.attention import decode_attention
+
+    assert cache.k_scale is None, "PP serving supports full-precision KV"
+    n_stages = mesh.shape[stage_axis]
+    m = microbatches or n_stages
+    b = tokens.shape[0]
+    assert b % m == 0, (b, m)
+    bm = b // m
+    assert cfg.n_layers % n_stages == 0
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+    s_max = cache.max_seq_len
+
+    x = L.gather_rows(params["embedding"],
+                      tokens[:, None]).astype(jnp.dtype(cfg.dtype))  # [B,1,H]
+    h_dim = x.shape[-1]
+    x_mb = x.reshape(m, bm, 1, h_dim)
+    lengths_mb = lengths.reshape(m, bm)
+    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    dtype = jnp.dtype(cfg.dtype)
+
+    def local(stage_layers, k_c, v_c, x_mb, lengths_mb):
+        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
+
+        def stage_apply(h, mb_idx, valid, k_c, v_c):
+            lens = lengths_mb[mb_idx]                     # [bm]
+            positions = lens[:, None]
+
+            def body(carry, xs):
+                layer, k_li, v_li = xs
+                # shared decode block halves (models/llama._decode_qkv /
+                # _decode_finish) keep PP token-for-token with decode_step
+                q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
+                orig_k = jax.lax.dynamic_slice(
+                    k_li, (mb_idx * bm, 0, 0), (bm, s_max, cfg.kv_dim))
+                orig_v = jax.lax.dynamic_slice(
+                    v_li, (mb_idx * bm, 0, 0), (bm, s_max, cfg.kv_dim))
+                k_rows = L._write_token_kv(
+                    orig_k, k[:, 0].reshape(bm, cfg.kv_dim).astype(
+                        orig_k.dtype), lens)
+                v_rows = L._write_token_kv(
+                    orig_v, v[:, 0].reshape(bm, cfg.kv_dim).astype(
+                        orig_v.dtype), lens)
+                attn = decode_attention(
+                    q,
+                    k_rows.astype(dtype).reshape(bm, s_max, cfg.n_kv_heads,
+                                                 cfg.head_dim),
+                    v_rows.astype(dtype).reshape(bm, s_max, cfg.n_kv_heads,
+                                                 cfg.head_dim),
+                    lens + 1)
+                hx = L._decode_finish(
+                    cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
+                # garbage-tick masking at ROW granularity: only this
+                # microbatch's bm rows move, not the whole cache slice
+                k_li = jax.lax.dynamic_update_slice(
+                    k_li, jnp.where(valid, k_rows, orig_k),
+                    (mb_idx * bm, 0, 0))
+                v_li = jax.lax.dynamic_update_slice(
+                    v_li, jnp.where(valid, v_rows, orig_v),
+                    (mb_idx * bm, 0, 0))
+                return hx, (k_li, v_li)
+
+            h, (k_new, v_new) = jax.lax.scan(body, h, (layers, k_c, v_c))
+            return h, k_new, v_new
+
+        ticks = m + n_st - 1
+        out_buf = jnp.zeros((m, bm, 1, h_dim), x_mb.dtype)
+        cur = jnp.zeros((bm, 1, h_dim), x_mb.dtype)
+
+        def tick(t, carry):
+            cur, out_buf, k_c, v_c = carry
+            mb = jnp.clip(t - my, 0, m - 1)
+            valid = jnp.logical_and(t - my >= 0, t - my < m)
+            feed = x_mb[jnp.minimum(t, m - 1)]
+            h_in = jnp.where(my == 0, feed, cur)
+            h_out, k_c, v_c = stage_apply(h_in, mb, valid, k_c, v_c)
+            mb_done = t - (n_st - 1)
+            write = jnp.logical_and(my == n_st - 1, mb_done >= 0)
+            out_buf = jax.lax.cond(
+                write,
+                lambda buf: jax.lax.dynamic_update_index_in_dim(
+                    buf, h_out, jnp.maximum(mb_done, 0), 0),
+                lambda buf: buf, out_buf)
+            cur = jax.lax.ppermute(h_out, stage_axis, perm)
+            return cur, out_buf, k_c, v_c
+
+        cur, out_buf, k_c, v_c = jax.lax.fori_loop(
+            0, ticks, tick, (cur, out_buf, k_c, v_c))
+        contrib = jnp.where(my == n_st - 1, out_buf, jnp.zeros_like(out_buf))
+        return jax.lax.psum(contrib, stage_axis), k_c, v_c
+
+    out, k_new, v_new = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), kv_cache_stage_specs(),
+                  kv_cache_stage_specs(), P(*(None,) * 4), P(None, None)),
+        out_specs=(P(*(None,) * 4), kv_cache_stage_specs(),
+                   kv_cache_stage_specs()),
+        check_vma=False,
+    )(stacked, cache.k, cache.v, x_mb, lengths_mb)
+
+    logits = L._logits(cfg, params, out.reshape(b, 1, h_dim))[:, 0]
+    return type(cache)(k_new, v_new), logits

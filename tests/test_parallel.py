@@ -633,3 +633,90 @@ def test_contiguous_tp_engine_cache_sharded(cpu_devices):
         got = eng.generate(prompts, max_new_tokens=6)
         for r, g in zip(ref, got):
             assert r.token_ids == g.token_ids, kv_dtype
+
+
+def test_pp_prefill_decode_matches_plain(cpu_devices):
+    """PP SERVING (VERDICT r1 item 9): pipelined prefill writes per-stage
+    KV (cache layer axis sharded over "stage") and the pipelined decode
+    step — slot-group microbatches flowing GPipe-style — produces the
+    plain path's exact greedy tokens over multiple steps."""
+    from k8s_llm_rca_tpu.parallel import (
+        llama_pp_decode_step, llama_pp_prefill, stack_llama_stages,
+    )
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    n_stages, m, b, s_pad, steps = 2, 2, 4, 16, 5
+    mesh = build_mesh(MeshConfig(stage=n_stages),
+                      devices=cpu_devices[:n_stages])
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s_pad), 0,
+                                cfg.vocab_size)
+    lengths = jnp.asarray([16, 13, 9, 16], jnp.int32)
+
+    # plain reference: batched prefill + stepwise greedy decode
+    ref_cache = llama.init_cache(cfg, b, cfg.max_seq_len)
+    ref_cache, ref_logits = llama.prefill_batch(
+        cfg, params, ref_cache, tokens, lengths, jnp.arange(b))
+    ref_toks = [jnp.argmax(ref_logits, -1)]
+    ref_lens = lengths
+    for _ in range(steps - 1):
+        ref_cache, lg = llama.decode_step(cfg, params, ref_cache,
+                                          ref_toks[-1], ref_lens)
+        ref_lens = ref_lens + 1
+        ref_toks.append(jnp.argmax(lg, -1))
+
+    # PP: same schedule through the stage pipeline
+    stacked = stack_llama_stages(params, n_stages)
+    pp_cache = llama.init_cache(cfg, b, cfg.max_seq_len)
+    pp_cache, pp_logits = llama_pp_prefill(
+        cfg, params, pp_cache, tokens, lengths, mesh, microbatches=m,
+        stacked_layers=stacked)
+    np.testing.assert_allclose(np.asarray(pp_logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    pp_toks = [jnp.argmax(pp_logits, -1)]
+    pp_lens = lengths
+    for _ in range(steps - 1):
+        pp_cache, lg = llama_pp_decode_step(
+            cfg, params, pp_cache, pp_toks[-1], pp_lens, mesh,
+            microbatches=m, stacked_layers=stacked)
+        pp_lens = pp_lens + 1
+        pp_toks.append(jnp.argmax(lg, -1))
+
+    for r, g in zip(ref_toks, pp_toks):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+    # the caches agree where valid (same KV written stage-locally)
+    np.testing.assert_allclose(np.asarray(pp_cache.k),
+                               np.asarray(ref_cache.k), rtol=1e-4, atol=1e-4)
+
+
+def test_pp_decode_under_jit_with_sharded_cache(cpu_devices):
+    """The PP decode step compiles under jit with the cache PLACED sharded
+    (layer axis over "stage") — each stage device holds 1/P of KV bytes."""
+    from jax.sharding import NamedSharding
+    from k8s_llm_rca_tpu.parallel import (
+        kv_cache_stage_specs, llama_pp_decode_step, llama_pp_prefill,
+    )
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    b = 4
+    cache = llama.init_cache(cfg, b, cfg.max_seq_len)
+    spec = NamedSharding(mesh, kv_cache_stage_specs())
+    cache = type(cache)(jax.device_put(cache.k, spec),
+                        jax.device_put(cache.v, spec))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (b, 16), 0,
+                                cfg.vocab_size)
+    lengths = jnp.full((b,), 16, jnp.int32)
+    from k8s_llm_rca_tpu.parallel import stack_llama_stages
+
+    stacked = stack_llama_stages(params, 2)     # hoisted off the hot path
+    cache, logits = llama_pp_prefill(cfg, params, cache, tokens, lengths,
+                                     mesh, stacked_layers=stacked)
+
+    step = jax.jit(lambda c, t, ln: llama_pp_decode_step(
+        cfg, params, c, t, ln, mesh, stacked_layers=stacked))
+    cache, logits = step(cache, jnp.argmax(logits, -1), lengths)
+    assert bool(jnp.isfinite(logits).all())
+    shard_shape = cache.k.sharding.shard_shape(cache.k.shape)
+    assert shard_shape[0] == cfg.n_layers // 2      # layers over stages

@@ -24,7 +24,6 @@ find_metapath/find_srckind_metapath_neo4j.py:20-45) — made explicit,
 local, and testable.
 """
 
-import json
 import os
 
 import pytest
@@ -90,10 +89,11 @@ def test_real_incident_end_to_end(real_stack):
     native, external = find_native_external_kinds(meta)
     vocabulary = set(native) | set(external)
     # re-extract the stage-1 plan from the locator thread to inspect it
+    from k8s_llm_rca_tpu.utils.fenced import extract_json
+
     reply = pipeline.locator.get_last_k_message(1).data[0] \
         .content[0].text.value
-    body = reply.split("```json\n", 1)[1].rsplit("```", 1)[0]
-    plan = json.loads(body)
+    plan = extract_json(reply)       # the production fence parser
     assert plan["DestinationKind"] in vocabulary
     assert all(r in vocabulary for r in plan["RelevantResources"])
 
