@@ -797,32 +797,52 @@ class SchemaGrammar:
         return Constraint(force=best)
 
     def constraint(self, remaining: Optional[int] = None) -> Constraint:
+        """Budget soundness: a fixed close-margin is NOT enough for schema
+        templates — one sampled ',' can commit the document to a whole
+        mandatory item, jumping the minimal completion by dozens of chars.
+        The mask is therefore BUDGET-AWARE: a token is legal only if the
+        document can still complete within ``remaining`` after it (the
+        per-token completion lengths are cached per state)."""
         if self.auto.complete:
             return Constraint(force=self.eos_id)
-        if remaining is not None:
-            completion = self.auto.minimal_completion()
-            if remaining <= len(completion) + self._close_margin:
-                if not completion:
-                    return Constraint(force=self.eos_id)
-                return self._force_char(completion[0])
         forced = self._forced_literal()
         if forced is not None:
-            return forced
+            # literal span: skip the O(V) mask build — the forced token is
+            # ON the template path, so it can only shrink the completion;
+            # verify the budget on it directly
+            if remaining is None:
+                return forced
+            sim = self.auto.clone()
+            for ch in self._strings[forced.force]:
+                assert sim.accept(ch)
+            if len(sim.minimal_completion()) <= remaining - 2:
+                return forced
         key = self.auto.state_key()
-        allow = self._mask_cache.get(key)
-        if allow is None:
+        entry = self._mask_cache.get(key)
+        if entry is None:
             allow = np.zeros((self.tokenizer.vocab_size,), bool)
+            next_len = np.full((self.tokenizer.vocab_size,),
+                               np.iinfo(np.int32).max, np.int32)
             for t, s in enumerate(self._strings):
-                if not s or all(c in WS for c in s):
-                    continue
+                if not s:
+                    continue   # empty decodes would self-loop forever;
+                # (pure-WS tokens stay legal: schema templates REQUIRE
+                # their separators' whitespace, unlike free-form JSON)
                 sim = self.auto.clone()
                 if all(sim.accept(c) for c in s):
                     allow[t] = True
-            if self.auto.complete:
-                allow[self.eos_id] = True
-            self._mask_cache[key] = allow
+                    next_len[t] = len(sim.minimal_completion())
+            self._mask_cache[key] = entry = (allow, next_len)
+        allow, next_len = entry
+        if remaining is not None:
+            # the token itself + the completion chars (1 token/char worst
+            # case) + the EOS token must all fit the budget
+            allow = allow & (next_len <= remaining - 2)
         if not allow.any():
             return self._force_char(self.auto.minimal_completion()[0])
+        hits = np.flatnonzero(allow)
+        if len(hits) == 1:
+            return Constraint(force=int(hits[0]))
         return Constraint(allow=allow)
 
     def advance(self, token: int) -> None:
@@ -846,7 +866,15 @@ def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
     if name is None:
         return None
     if isinstance(name, dict):
-        return SchemaGrammar(name, tokenizer)
+        # prefer the compiled DFA (tables cached per tokenizer; enables the
+        # engines' on-device constrained scan); fall back to the
+        # interpreted FSM when the schema's state space is too large
+        try:
+            return DFAGrammar(name, tokenizer)
+        except ValueError as e:
+            get_logger(__name__).info("schema DFA unavailable (%s); using "
+                                      "the interpreted FSM", e)
+            return SchemaGrammar(name, tokenizer)
     if name == "json":
         if prefer_native:
             try:
@@ -858,3 +886,252 @@ def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
         return JsonGrammar(tokenizer)
     raise ValueError(f"unknown grammar {name!r} (supported: 'json' or a "
                      f"schema dict)")
+
+
+# ---------------------------------------------------------------------------
+# compiled DFA: schema-constrained decode ON the device (zero host sync)
+# ---------------------------------------------------------------------------
+#
+# SchemaAutomaton is FINITE by construction (fixed keys, bounded strings /
+# arrays / integers), so the whole grammar compiles to lookup tables:
+#
+#   char_next  [S, C]   char-level DFA (BFS over automaton states)
+#   token_next [S, V]   char DFA lifted through each token's characters
+#   allow      [S, V]   token legal in state s (host mask, bit-identical)
+#   dist       [S]      chars to the nearest completion (budget force-close)
+#   close_tok  [S]      next token on that shortest completion path
+#   complete   [S]      full document consumed -> force EOS
+#
+# With the FSM reduced to gathers, the jitted decode scan applies the
+# grammar itself (engine.decode_scan_dfa): mask -> sample -> state
+# transition, all on device — the "constrained decode that stays on the
+# fast decode path" hard part of SURVEY §7, solved the TPU way.  Host-side
+# DFAGrammar speaks the same constraint/advance protocol (table lookups),
+# so stepwise ticks, preemption and retries keep working unchanged.
+
+_DFA_REJECT = -1
+_DFA_MAX_STATES = 200_000
+_DFA_FAR = np.int32(1 << 30)
+
+
+class DFATables:
+    """Host (numpy) tables for one compiled schema x tokenizer."""
+
+    __slots__ = ("token_next", "allow", "dist", "close_tok", "complete",
+                 "start", "free_state", "close_margin", "eos_id",
+                 "n_states", "single")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def _enumerate_char_dfa(root, alphabet: str):
+    """BFS the automaton over ``alphabet``; returns (char_next [S, C],
+    complete [S], automatons-per-state for distance bootstrapping)."""
+    start = SchemaAutomaton(root)
+    ids: Dict[Tuple, int] = {start.state_key(): 0}
+    autos = [start]
+    rows: List[List[int]] = []
+    frontier = [0]
+    while frontier:
+        nxt_frontier: List[int] = []
+        for sid in frontier:
+            a = autos[sid]
+            row = []
+            for ch in alphabet:
+                sim = a.clone()
+                if not sim.accept(ch):
+                    row.append(_DFA_REJECT)
+                    continue
+                key = sim.state_key()
+                tid = ids.get(key)
+                if tid is None:
+                    tid = len(autos)
+                    if tid >= _DFA_MAX_STATES:
+                        raise ValueError(
+                            f"schema DFA exceeds {_DFA_MAX_STATES} states")
+                    ids[key] = tid
+                    autos.append(sim)
+                    nxt_frontier.append(tid)
+                row.append(tid)
+            rows.append(row)
+        frontier = nxt_frontier
+    char_next = np.asarray(rows, np.int32)
+    complete = np.asarray([a.complete for a in autos], bool)
+    return char_next, complete
+
+
+def compile_schema_dfa(schema: Dict, tokenizer: Tokenizer) -> DFATables:
+    """Compile a schema to device-ready DFA tables (see module section)."""
+    root = _compile_schema(schema)
+    strings = _token_strings(tokenizer)
+    char_token, close_margin = _vocab_force_tables(strings)
+
+    # alphabet: every char any vocab token can emit (others always reject)
+    alphabet = sorted(set("".join(strings)))
+    col = {ch: i for i, ch in enumerate(alphabet)}
+    char_next, complete = _enumerate_char_dfa(root, alphabet)
+    n = char_next.shape[0]
+
+    # dist (chars to completion) + the closing char, by fixpoint relaxation
+    dist = np.where(complete, 0, _DFA_FAR).astype(np.int64)
+    close_col = np.zeros((n,), np.int32)
+    # neighbor distances: dist over char_next with REJECT -> FAR
+    for _ in range(n + 1):
+        nb = np.where(char_next >= 0, dist[np.maximum(char_next, 0)],
+                      _DFA_FAR)                        # [S, C]
+        best = nb.min(axis=1)
+        cand = np.minimum(dist, 1 + best)
+        if (cand == dist).all():
+            break
+        improved = cand < dist
+        close_col = np.where(improved, nb.argmin(axis=1), close_col)
+        dist = cand
+    if (dist >= _DFA_FAR).any():
+        raise ValueError("schema DFA has states with no completion path")
+
+    # lift the char DFA through every token's characters: [S, V]
+    V = len(strings)
+    max_len = max((len(s) for s in strings), default=1)
+    # the alphabet is built FROM the vocab strings, so every token char
+    # has a column by construction
+    tok_chars = np.full((V, max_len), -1, np.int32)
+    tok_len = np.zeros((V,), np.int32)
+    for t, s in enumerate(strings):
+        tok_len[t] = len(s)
+        for i, ch in enumerate(s):
+            tok_chars[t, i] = col[ch]
+
+    cur = np.broadcast_to(np.arange(n, dtype=np.int32)[:, None],
+                          (n, V)).copy()
+    for pos in range(max_len):
+        active = pos < tok_len                        # [V]
+        chars = np.maximum(tok_chars[:, pos], 0)      # [V]
+        safe = np.maximum(cur, 0)
+        stepped = char_next[safe, chars[None, :]]     # [S, V]
+        stepped = np.where(cur < 0, _DFA_REJECT, stepped)
+        cur = np.where(active[None, :], stepped, cur)
+
+    allow = cur >= 0
+    # ban empty decodes (they would self-loop forever); pure-WS tokens stay
+    # LEGAL — schema templates REQUIRE their separators' spaces, unlike
+    # free-form JSON where whitespace is optional padding
+    for t, s in enumerate(strings):
+        if not s:
+            allow[:, t] = False
+    allow[:, tokenizer.eos_id] = False     # EOS is forced via `complete`
+    allow[complete] = False                # complete -> force EOS
+
+    # closing token per state: exact single-char token for the closing char
+    close_tok = np.zeros((n,), np.int32)
+    for s in range(n):
+        if complete[s]:
+            close_tok[s] = tokenizer.eos_id
+            continue
+        ch = alphabet[close_col[s]]
+        tid = char_token.get(ch)
+        if tid is None:
+            tid = tokenizer.encode(ch)[0]
+        close_tok[s] = tid
+
+    # singleton states (literal spans): exactly one legal token -> the
+    # host constraint can FORCE it instead of shipping a mask
+    single = np.where(allow.sum(axis=1) == 1,
+                      allow.argmax(axis=1), -1).astype(np.int32)
+
+    # append the FREE row (unconstrained slots in a mixed scan batch)
+    free = n
+    token_next = np.concatenate(
+        [np.where(cur >= 0, cur, free).astype(np.int32),
+         np.full((1, V), free, np.int32)], axis=0)
+    allow = np.concatenate([allow, np.ones((1, V), bool)], axis=0)
+    # FREE row distance is 0: unconstrained slots must always pass the
+    # budget-fits mask (their budgets are enforced by the engine, not the
+    # grammar)
+    dist = np.concatenate([dist.astype(np.int32), [0]])
+    close_tok = np.concatenate([close_tok, [tokenizer.eos_id]])
+    complete = np.concatenate([complete, [False]])
+    single = np.concatenate([single, [-1]])
+
+    return DFATables(token_next=token_next, allow=allow, dist=dist,
+                     close_tok=close_tok, complete=complete, start=0,
+                     free_state=free, close_margin=close_margin,
+                     eos_id=tokenizer.eos_id, n_states=n + 1,
+                     single=single)
+
+
+def _dfa_cache_get(schema: Dict, tokenizer: Tokenizer) -> DFATables:
+    """Per-tokenizer cache keyed by the canonical schema JSON (compilation
+    costs seconds; serving reuses one schema for thousands of runs)."""
+    import json as _json
+
+    key = _json.dumps(schema, sort_keys=True, default=str)
+    cache = getattr(tokenizer, "_dfa_tables_cache", None)
+    if cache is None:
+        cache = {}
+        tokenizer._dfa_tables_cache = cache
+    tables = cache.get(key)
+    if tables is None:
+        tables = compile_schema_dfa(schema, tokenizer)
+        # bound the cache: a server fed ever-changing schemas must not
+        # accumulate multi-MB table sets forever (FIFO eviction; dict
+        # preserves insertion order)
+        while len(cache) >= 8:
+            cache.pop(next(iter(cache)))
+        cache[key] = tables
+    return tables
+
+
+class DFAGrammar:
+    """SchemaGrammar drop-in backed by compiled tables.
+
+    Same host protocol (constraint/advance) via O(1) lookups, PLUS
+    ``tables`` for the engines' on-device constrained scan
+    (engine.decode_scan_dfa) — grammar slots no longer force per-token
+    host ticks."""
+
+    def __init__(self, schema: Dict, tokenizer: Tokenizer):
+        self.tokenizer = tokenizer
+        self.tables = _dfa_cache_get(schema, tokenizer)
+        self.eos_id = tokenizer.eos_id
+        self.state = self.tables.start
+
+    @property
+    def done(self) -> bool:
+        return bool(self.tables.complete[self.state])
+
+    def min_budget(self) -> int:
+        return int(self.tables.dist[self.tables.start]) \
+            + self.tables.close_margin
+
+    def constraint(self, remaining: Optional[int] = None) -> Constraint:
+        """Budget-aware: only tokens from which the document still
+        completes within ``remaining`` are legal (dist of the successor
+        state; a fixed margin is unsound for templates — see
+        SchemaGrammar.constraint)."""
+        t = self.tables
+        if t.complete[self.state]:
+            return Constraint(force=self.eos_id)
+        row = t.allow[self.state]
+        if remaining is not None:
+            nxt = t.token_next[self.state]
+            row = row & (np.where(row, t.dist[np.minimum(
+                nxt, t.n_states - 1)], _DFA_FAR) <= remaining - 2)
+        if not row.any():
+            return Constraint(force=int(t.close_tok[self.state]))
+        hits = np.flatnonzero(row)
+        if len(hits) == 1:
+            return Constraint(force=int(hits[0]))
+        return Constraint(allow=row)
+
+    def advance(self, token: int) -> None:
+        if token == self.eos_id:
+            return
+        t = self.tables
+        nxt = int(t.token_next[self.state, token])
+        if nxt == t.free_state and not t.allow[self.state, token]:
+            raise ValueError(
+                f"token {token} violates the schema DFA in state "
+                f"{self.state}")
+        self.state = nxt

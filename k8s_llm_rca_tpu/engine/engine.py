@@ -184,6 +184,9 @@ class EngineBase:
     model_cfg: ModelConfig
     engine_cfg: EngineConfig
     tokenizer: Tokenizer
+    # whether _scan_tick can run compiled-DFA grammar slots on device
+    # (engine.decode_scan_dfa); the contiguous engine overrides to True
+    _dfa_scan: bool = False
 
     # -------------------------------------------------------- shared api
 
@@ -311,9 +314,16 @@ class EngineBase:
         limit = self.engine_cfg.decode_chunk
         if limit <= 1 or self._pending:
             return 1
+        tables = None
         for slot, st in self._active.items():
             if st.grammar is not None:
-                return 1
+                t = getattr(st.grammar, "tables", None)
+                if t is None or not self._dfa_scan:
+                    return 1           # interpreted FSM: per-token host work
+                if tables is None:
+                    tables = t
+                elif t is not tables:
+                    return 1           # mixed grammars: no shared state space
             limit = min(limit, self._budget_remaining(st),
                         self._chunk_bound(slot))
         chunk = 1
@@ -623,6 +633,11 @@ class InferenceEngine(EngineBase):
         self._decode_scan = jax.jit(
             functools.partial(decode_scan, ep_mesh=ep_mesh),
             static_argnums=(0, 6, 7, 8))
+        self._dfa_scan = True
+        self._decode_scan_dfa = jax.jit(
+            functools.partial(decode_scan_dfa, ep_mesh=ep_mesh),
+            static_argnums=(0, 6, 7, 8))
+        self._dfa_dev: Dict[int, tuple] = {}   # id(tables) -> device arrays
         self._prompts: Dict[int, List[int]] = {}   # seq_id -> prompt (for
         # n-gram draft lookup; dropped at retirement)
 
@@ -819,19 +834,61 @@ class InferenceEngine(EngineBase):
 
     # ------------------------------------------------- chunked scan tick
 
+    def _dfa_device_tables(self, tables):
+        """Upload one grammar's DFA tables once; reuse across scans."""
+        dev = self._dfa_dev.get(id(tables))
+        if dev is None:
+            dev = (jnp.asarray(tables.allow), jnp.asarray(tables.token_next),
+                   jnp.asarray(tables.dist), jnp.asarray(tables.close_tok),
+                   jnp.asarray(tables.complete), tables)
+            # bound device-table residency (the tuple keeps `tables` alive,
+            # so id() cannot be reused while an entry lives)
+            while len(self._dfa_dev) >= 4:
+                self._dfa_dev.pop(next(iter(self._dfa_dev)))
+            self._dfa_dev[id(tables)] = dev
+        return dev
+
     def _scan_tick(self, chunk: int) -> List[SequenceResult]:
         """Commit ``chunk`` decode steps from one on-device scan; token
-        accounting and finish semantics identical to the stepwise tick."""
+        accounting and finish semantics identical to the stepwise tick.
+        Grammar slots whose FSM compiled to DFA tables run constrained
+        INSIDE the scan (decode_scan_dfa) — zero per-token host work."""
         active_slots = list(self._active)
+        tables = next((st.grammar.tables for st in self._active.values()
+                       if st.grammar is not None), None)
         self._key, sub = jax.random.split(self._key)
-        with METRICS.timer("engine.decode_step"):
-            self.cache, toks, self.lengths = self._decode_scan(
-                self.model_cfg, self.params, self.cache, self.cur_tokens,
-                self.lengths, sub, chunk, self.sampling,
-                self.tokenizer.eos_id)
+        if tables is None:
+            with METRICS.timer("engine.decode_step"):
+                self.cache, toks, self.lengths = self._decode_scan(
+                    self.model_cfg, self.params, self.cache,
+                    self.cur_tokens, self.lengths, sub, chunk,
+                    self.sampling, self.tokenizer.eos_id)
+        else:
+            allow_t, next_t, dist_t, close_t, complete_t, _ =                 self._dfa_device_tables(tables)
+            b = self.engine_cfg.max_batch
+            states = np.full((b,), tables.free_state, np.int32)
+            remaining = np.full((b,), np.int32(1 << 30), np.int32)
+            for slot, st in self._active.items():
+                if st.grammar is not None:
+                    states[slot] = st.grammar.state
+                    remaining[slot] = self._budget_remaining(st)
+            with METRICS.timer("engine.decode_step"):
+                self.cache, toks, self.lengths, _ = self._decode_scan_dfa(
+                    self.model_cfg, self.params, self.cache,
+                    self.cur_tokens, self.lengths, sub, chunk,
+                    self.sampling, self.tokenizer.eos_id,
+                    jnp.asarray(states), jnp.asarray(remaining),
+                    allow_t, next_t, dist_t, close_t, complete_t)
         toks_host = np.asarray(toks)                     # [chunk, B]
         self.cur_tokens = toks[-1]
-        return self._commit_scanned(active_slots, toks_host, chunk)
+
+        def post_commit(slot: int, token: int) -> None:
+            st = self._active.get(slot)
+            if st is not None and st.grammar is not None:
+                st.grammar.advance(token)    # host DFA mirrors the device
+
+        return self._commit_scanned(active_slots, toks_host, chunk,
+                                    post_commit)
 
     # --------------------------------------------- speculative decoding
 
@@ -903,3 +960,63 @@ def decode_scan(
     (cache, _, lengths, _, _), toks = jax.lax.scan(
         body, (cache, cur_tokens, lengths, done0, key), None, length=n_steps)
     return cache, toks, lengths
+
+
+def decode_scan_dfa(
+    cfg: ModelConfig,
+    params,
+    cache: llama.KVCache,
+    cur_tokens: jnp.ndarray,    # [B]
+    lengths: jnp.ndarray,       # [B]
+    key: jax.Array,
+    n_steps: int,
+    sampling: SamplingParams,
+    eos_id: int,
+    states: jnp.ndarray,        # [B] int32 DFA state per slot (FREE = none)
+    remaining: jnp.ndarray,     # [B] int32 token budget per slot
+    allow_t: jnp.ndarray,       # [S, V] bool
+    next_t: jnp.ndarray,        # [S, V] int32
+    dist_t: jnp.ndarray,        # [S] int32
+    close_t: jnp.ndarray,       # [S] int32
+    complete_t: jnp.ndarray,    # [S] bool
+    ep_mesh=None,
+) -> Tuple[llama.KVCache, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``decode_scan`` with the grammar DFA riding INSIDE the scan.
+
+    Per step, entirely on device: gather the state's token mask, sample
+    under it, force the budget-close / EOS transitions, and step the DFA
+    (constrain.compile_schema_dfa tables).  Grammar-constrained sequences
+    thus decode in chunked dispatches with ZERO per-token host work —
+    SURVEY §7's "constrained decode that stays on the fast decode path".
+    Returns (cache, tokens [n_steps, B], lengths, states).
+    """
+
+    def body(carry, _):
+        cache, cur, lens, done, states, remaining, key = carry
+        cache, logits = llama.decode_step(cfg, params, cache, cur, lens,
+                                          ep_mesh)
+        key, sub = jax.random.split(key)
+        # budget-aware mask: a token is legal only if the document can
+        # still complete within the remaining budget after taking it
+        # (dist of the successor state; matches DFAGrammar.constraint)
+        nxt_states = next_t[states]                       # [B, V]
+        fits = dist_t[nxt_states] <= (remaining - 2)[:, None]
+        rows = allow_t[states] & fits
+        sampled = sample_tokens_masked(logits, sub, sampling, rows)
+        # empty row (sub-minimal budget, guarded at submit): force close
+        nxt = jnp.where(rows.any(axis=-1), sampled, close_t[states])
+        nxt = jnp.where(complete_t[states], eos_id, nxt)
+        newly_done = done | (nxt == eos_id)
+        advance = jnp.logical_not(done)
+        cur = jnp.where(advance, nxt, cur)
+        lens = lens + advance.astype(jnp.int32)
+        step_dfa = advance & (nxt != eos_id)
+        states = jnp.where(step_dfa, next_t[states, nxt], states)
+        remaining = remaining - advance.astype(jnp.int32)
+        return (cache, cur, lens, newly_done, states, remaining, key), cur
+
+    done0 = jnp.zeros_like(cur_tokens, dtype=bool)
+    (cache, _, lengths, _, states, _, _), toks = jax.lax.scan(
+        body, (cache, cur_tokens, lengths, done0, states, remaining, key),
+        None, length=n_steps)
+    return cache, toks, lengths, states

@@ -346,7 +346,141 @@ class TestSchemaGrammar:
         assert parsed["DestinationKind"] in KINDS
 
     def test_make_grammar_accepts_schema_dict(self):
-        from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
+        from k8s_llm_rca_tpu.engine.constrain import (
+            DFAGrammar, SchemaGrammar,
+        )
 
         g = make_grammar(PLAN_SCHEMA, get_tokenizer())
-        assert isinstance(g, SchemaGrammar)
+        # schemas compile to the DFA-backed grammar (SchemaGrammar is the
+        # fallback for state-space blowups)
+        assert isinstance(g, (DFAGrammar, SchemaGrammar))
+        if isinstance(g, DFAGrammar):
+            assert g.tables.n_states > 0
+
+
+class TestCompiledDFA:
+    """Schema grammars compiled to token-level DFA tables: on-device
+    constrained decode (engine.decode_scan_dfa) with zero per-token host
+    work.  The DFA must be constraint-for-constraint equivalent to the
+    interpreted SchemaGrammar."""
+
+    STRING_SCHEMA = {"type": "object", "properties": [
+        ("note", {"type": "string", "max_len": 10}),
+        ("n", {"type": "integer", "max_digits": 3}),
+        ("ok", {"type": "boolean"})]}
+
+    @staticmethod
+    def _as_set(c):
+        import numpy as np
+
+        return ({int(c.force)} if c.force is not None
+                else set(np.flatnonzero(c.allow).tolist()))
+
+    @pytest.mark.parametrize("schema", [PLAN_SCHEMA, STRING_SCHEMA])
+    def test_matches_interpreted_grammar(self, schema):
+        import numpy as np
+
+        from k8s_llm_rca_tpu.engine.constrain import (
+            DFAGrammar, SchemaGrammar,
+        )
+
+        tok = get_tokenizer()
+        for seed in range(3):
+            rng = np.random.default_rng(seed)
+            ref, dfa = SchemaGrammar(schema, tok), DFAGrammar(schema, tok)
+            budget = 700
+            for step in range(budget):
+                sr = self._as_set(ref.constraint(remaining=budget - step))
+                sd = self._as_set(dfa.constraint(remaining=budget - step))
+                if len(sr) > 1 or len(sd) > 1:
+                    # non-forced steps must agree exactly; forced closes
+                    # may differ only in equally-minimal path choice
+                    assert sr == sd, (seed, step, sorted(sr ^ sd)[:6])
+                t = (next(iter(sr)) if len(sr) == 1
+                     else int(rng.choice(sorted(sr))))
+                if t == tok.eos_id:
+                    break
+                ref.advance(t)
+                dfa.advance(t)
+            else:
+                raise AssertionError("walk never terminated")
+            assert ref.done == dfa.done
+
+    def test_make_grammar_compiles_schemas(self):
+        from k8s_llm_rca_tpu.engine.constrain import DFAGrammar
+
+        g = make_grammar(PLAN_SCHEMA, get_tokenizer())
+        assert isinstance(g, DFAGrammar)
+        assert g.tables.n_states > 100
+        # tables are cached per tokenizer: same object on re-make
+        tok = get_tokenizer()
+        assert make_grammar(PLAN_SCHEMA, tok).tables \
+            is make_grammar(PLAN_SCHEMA, tok).tables
+
+    def test_engine_chunked_scan_matches_stepwise(self):
+        """The DFA rides inside the decode scan: chunked greedy output ==
+        per-tick host-FSM output, and both parse + respect enums."""
+        outs = {}
+        tok = get_tokenizer()
+        cfg = TINY.replace(max_seq_len=512)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        for chunk in (1, 8):
+            ecfg = EngineConfig(max_batch=2, max_seq_len=512,
+                                prefill_buckets=(32,), max_new_tokens=256,
+                                temperature=0.0, decode_chunk=chunk)
+            eng = InferenceEngine(cfg, ecfg, params, tok)
+            ids = [eng.submit(tok.encode(p, add_bos=True),
+                              grammar=make_grammar(PLAN_SCHEMA, tok),
+                              max_new_tokens=256)
+                   for p in ("plan a", "plan b")]
+            res = {r.seq_id: r for r in eng.run_to_completion()}
+            outs[chunk] = [res[i].text for i in ids]
+            for text in outs[chunk]:
+                parsed = json.loads(text)
+                assert parsed["DestinationKind"] in KINDS
+        assert outs[1] == outs[8]
+
+    def test_engine_scan_mixed_grammar_and_free_slots(self):
+        """A scan batch mixing one DFA-constrained slot with unconstrained
+        slots: the FREE state row leaves free slots untouched."""
+        tok = get_tokenizer()
+        cfg = TINY.replace(max_seq_len=256)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch=3, max_seq_len=256,
+                            prefill_buckets=(32,), max_new_tokens=200,
+                            temperature=0.0, decode_chunk=8)
+        eng = InferenceEngine(cfg, ecfg, params, tok)
+        gid = eng.submit(tok.encode("plan", add_bos=True),
+                         grammar=make_grammar(PLAN_SCHEMA, tok),
+                         max_new_tokens=200)
+        fids = [eng.submit(tok.encode(p, add_bos=True), max_new_tokens=24)
+                for p in ("free one", "free two")]
+        # reference for the free slots: same engine config, no grammar slot
+        ref_eng = InferenceEngine(cfg, ecfg, params, tok)
+        ref_ids = [ref_eng.submit(tok.encode(p, add_bos=True),
+                                  max_new_tokens=24)
+                   for p in ("free one", "free two")]
+        res = {r.seq_id: r for r in eng.run_to_completion()}
+        ref = {r.seq_id: r for r in ref_eng.run_to_completion()}
+        json.loads(res[gid].text)
+        for f, r in zip(fids, ref_ids):
+            assert res[f].token_ids == ref[r].token_ids
+
+    def test_engine_budget_force_close_on_device(self):
+        """Tight budgets force-close THROUGH the scan: output still parses."""
+        tok = get_tokenizer()
+        cfg = TINY.replace(max_seq_len=512)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch=1, max_seq_len=512,
+                            prefill_buckets=(32,), max_new_tokens=256,
+                            temperature=1.0, top_k=40, decode_chunk=8)
+        eng = InferenceEngine(cfg, ecfg, params, tok)
+        g = make_grammar(PLAN_SCHEMA, tok)
+        budget = g.min_budget() + 8
+        sid = eng.submit(tok.encode("x", add_bos=True), grammar=g,
+                         max_new_tokens=budget)
+        (res,) = eng.run_to_completion()
+        assert res.seq_id == sid
+        parsed = json.loads(res.text)
+        assert parsed["DestinationKind"] in KINDS
+        assert res.completion_tokens <= budget
