@@ -813,9 +813,8 @@ class SchemaGrammar:
             if remaining is None:
                 return forced
             sim = self.auto.clone()
-            for ch in self._strings[forced.force]:
-                assert sim.accept(ch)
-            if len(sim.minimal_completion()) <= remaining - 2:
+            ok = all(sim.accept(ch) for ch in self._strings[forced.force])
+            if ok and len(sim.minimal_completion()) <= remaining - 2:
                 return forced
         key = self.auto.state_key()
         entry = self._mask_cache.get(key)
@@ -839,7 +838,10 @@ class SchemaGrammar:
             # case) + the EOS token must all fit the budget
             allow = allow & (next_len <= remaining - 2)
         if not allow.any():
-            return self._force_char(self.auto.minimal_completion()[0])
+            completion = self.auto.minimal_completion()
+            if not completion:          # already terminable: end cleanly
+                return Constraint(force=self.eos_id)
+            return self._force_char(completion[0])
         hits = np.flatnonzero(allow)
         if len(hits) == 1:
             return Constraint(force=int(hits[0]))
@@ -871,7 +873,7 @@ def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
         # interpreted FSM when the schema's state space is too large
         try:
             return DFAGrammar(name, tokenizer)
-        except ValueError as e:
+        except (ValueError, MemoryError) as e:
             get_logger(__name__).info("schema DFA unavailable (%s); using "
                                       "the interpreted FSM", e)
             return SchemaGrammar(name, tokenizer)
@@ -910,7 +912,11 @@ def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
 # so stepwise ticks, preemption and retries keep working unchanged.
 
 _DFA_REJECT = -1
-_DFA_MAX_STATES = 200_000
+# cap on the compiled tables' footprint: token_next int32 + allow bool per
+# (state, vocab) cell.  BFS enforces it incrementally, so oversized schemas
+# fail fast with ValueError and make_grammar falls back to the interpreted
+# FSM instead of allocating unbounded [S, V] arrays
+_DFA_MAX_TABLE_BYTES = 256 * 1024 * 1024
 _DFA_FAR = np.int32(1 << 30)
 
 
@@ -926,7 +932,7 @@ class DFATables:
             setattr(self, k, v)
 
 
-def _enumerate_char_dfa(root, alphabet: str):
+def _enumerate_char_dfa(root, alphabet: str, max_states: int):
     """BFS the automaton over ``alphabet``; returns (char_next [S, C],
     complete [S], automatons-per-state for distance bootstrapping)."""
     start = SchemaAutomaton(root)
@@ -948,9 +954,10 @@ def _enumerate_char_dfa(root, alphabet: str):
                 tid = ids.get(key)
                 if tid is None:
                     tid = len(autos)
-                    if tid >= _DFA_MAX_STATES:
+                    if tid >= max_states:
                         raise ValueError(
-                            f"schema DFA exceeds {_DFA_MAX_STATES} states")
+                            f"schema DFA exceeds {max_states} states "
+                            f"(table budget {_DFA_MAX_TABLE_BYTES >> 20} MB)")
                     ids[key] = tid
                     autos.append(sim)
                     nxt_frontier.append(tid)
@@ -971,7 +978,8 @@ def compile_schema_dfa(schema: Dict, tokenizer: Tokenizer) -> DFATables:
     # alphabet: every char any vocab token can emit (others always reject)
     alphabet = sorted(set("".join(strings)))
     col = {ch: i for i, ch in enumerate(alphabet)}
-    char_next, complete = _enumerate_char_dfa(root, alphabet)
+    max_states = max(256, _DFA_MAX_TABLE_BYTES // (5 * len(strings)))
+    char_next, complete = _enumerate_char_dfa(root, alphabet, max_states)
     n = char_next.shape[0]
 
     # dist (chars to completion) + the closing char, by fixpoint relaxation

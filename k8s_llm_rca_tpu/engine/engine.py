@@ -301,6 +301,47 @@ class EngineBase:
         engine bounds by distance to the slot's next page boundary)."""
         return self.engine_cfg.decode_chunk
 
+    def _dfa_device_tables(self, tables):
+        """Upload one grammar's DFA tables once; reuse across scans."""
+        dev_cache = getattr(self, "_dfa_dev", None)
+        if dev_cache is None:
+            dev_cache = self._dfa_dev = {}
+        dev = dev_cache.get(id(tables))
+        if dev is None:
+            dev = (jnp.asarray(tables.allow), jnp.asarray(tables.token_next),
+                   jnp.asarray(tables.dist), jnp.asarray(tables.close_tok),
+                   jnp.asarray(tables.complete), tables)
+            # bound device-table residency (the tuple keeps `tables` alive,
+            # so id() cannot be reused while an entry lives)
+            while len(dev_cache) >= 4:
+                dev_cache.pop(next(iter(dev_cache)))
+            dev_cache[id(tables)] = dev
+        return dev
+
+    def _dfa_scan_vectors(self, tables):
+        """[B] DFA state + remaining-budget vectors for a scan batch:
+        grammar slots carry their state, free slots the FREE row."""
+        b = self.engine_cfg.max_batch
+        states = np.full((b,), tables.free_state, np.int32)
+        remaining = np.full((b,), np.int32(1 << 30), np.int32)
+        for slot, st in self._active.items():
+            if st.grammar is not None:
+                states[slot] = st.grammar.state
+                remaining[slot] = self._budget_remaining(st)
+        return states, remaining
+
+    def _active_dfa_tables(self):
+        """The shared DFA tables of this tick's grammar slots (None when
+        no grammar slot is active; _scan_chunk guarantees uniformity)."""
+        return next((st.grammar.tables for st in self._active.values()
+                     if st.grammar is not None), None)
+
+    def _grammar_post_commit(self, slot: int, token: int) -> None:
+        """Keep host grammar FSMs in lockstep with scan-emitted tokens."""
+        st = self._active.get(slot)
+        if st is not None and st.grammar is not None:
+            st.grammar.advance(token)
+
     def _scan_chunk(self) -> int:
         """Device decode steps to run in ONE dispatch this tick.
 
@@ -834,28 +875,13 @@ class InferenceEngine(EngineBase):
 
     # ------------------------------------------------- chunked scan tick
 
-    def _dfa_device_tables(self, tables):
-        """Upload one grammar's DFA tables once; reuse across scans."""
-        dev = self._dfa_dev.get(id(tables))
-        if dev is None:
-            dev = (jnp.asarray(tables.allow), jnp.asarray(tables.token_next),
-                   jnp.asarray(tables.dist), jnp.asarray(tables.close_tok),
-                   jnp.asarray(tables.complete), tables)
-            # bound device-table residency (the tuple keeps `tables` alive,
-            # so id() cannot be reused while an entry lives)
-            while len(self._dfa_dev) >= 4:
-                self._dfa_dev.pop(next(iter(self._dfa_dev)))
-            self._dfa_dev[id(tables)] = dev
-        return dev
-
     def _scan_tick(self, chunk: int) -> List[SequenceResult]:
         """Commit ``chunk`` decode steps from one on-device scan; token
         accounting and finish semantics identical to the stepwise tick.
         Grammar slots whose FSM compiled to DFA tables run constrained
         INSIDE the scan (decode_scan_dfa) — zero per-token host work."""
         active_slots = list(self._active)
-        tables = next((st.grammar.tables for st in self._active.values()
-                       if st.grammar is not None), None)
+        tables = self._active_dfa_tables()
         self._key, sub = jax.random.split(self._key)
         if tables is None:
             with METRICS.timer("engine.decode_step"):
@@ -864,14 +890,9 @@ class InferenceEngine(EngineBase):
                     self.cur_tokens, self.lengths, sub, chunk,
                     self.sampling, self.tokenizer.eos_id)
         else:
-            allow_t, next_t, dist_t, close_t, complete_t, _ =                 self._dfa_device_tables(tables)
-            b = self.engine_cfg.max_batch
-            states = np.full((b,), tables.free_state, np.int32)
-            remaining = np.full((b,), np.int32(1 << 30), np.int32)
-            for slot, st in self._active.items():
-                if st.grammar is not None:
-                    states[slot] = st.grammar.state
-                    remaining[slot] = self._budget_remaining(st)
+            (allow_t, next_t, dist_t, close_t, complete_t,
+             _) = self._dfa_device_tables(tables)
+            states, remaining = self._dfa_scan_vectors(tables)
             with METRICS.timer("engine.decode_step"):
                 self.cache, toks, self.lengths, _ = self._decode_scan_dfa(
                     self.model_cfg, self.params, self.cache,
@@ -882,13 +903,8 @@ class InferenceEngine(EngineBase):
         toks_host = np.asarray(toks)                     # [chunk, B]
         self.cur_tokens = toks[-1]
 
-        def post_commit(slot: int, token: int) -> None:
-            st = self._active.get(slot)
-            if st is not None and st.grammar is not None:
-                st.grammar.advance(token)    # host DFA mirrors the device
-
         return self._commit_scanned(active_slots, toks_host, chunk,
-                                    post_commit)
+                                    self._grammar_post_commit)
 
     # --------------------------------------------- speculative decoding
 
@@ -962,6 +978,33 @@ def decode_scan(
     return cache, toks, lengths
 
 
+def dfa_scan_step(logits, cur, lens, done, states, remaining, key,
+                  sampling: SamplingParams, eos_id: int,
+                  allow_t, next_t, dist_t, close_t, complete_t):
+    """One on-device DFA-constrained sampling step, shared by the
+    contiguous and paged scan bodies (single source for the budget-fits
+    mask, force-close, complete->EOS, and state-transition logic).
+
+    Returns (cur', lens', done', states', remaining', sub_key_consumed).
+    """
+    key, sub = jax.random.split(key)
+    nxt_states = next_t[states]                       # [B, V]
+    fits = dist_t[nxt_states] <= (remaining - 2)[:, None]
+    rows = allow_t[states] & fits
+    sampled = sample_tokens_masked(logits, sub, sampling, rows)
+    # empty row (sub-minimal budget, guarded at submit): force close
+    nxt = jnp.where(rows.any(axis=-1), sampled, close_t[states])
+    nxt = jnp.where(complete_t[states], eos_id, nxt)
+    newly_done = done | (nxt == eos_id)
+    advance = jnp.logical_not(done)
+    cur = jnp.where(advance, nxt, cur)
+    lens = lens + advance.astype(lens.dtype)
+    step_dfa = advance & (nxt != eos_id)
+    states = jnp.where(step_dfa, next_t[states, nxt], states)
+    remaining = remaining - advance.astype(jnp.int32)
+    return cur, lens, newly_done, states, remaining, key
+
+
 def decode_scan_dfa(
     cfg: ModelConfig,
     params,
@@ -995,25 +1038,10 @@ def decode_scan_dfa(
         cache, cur, lens, done, states, remaining, key = carry
         cache, logits = llama.decode_step(cfg, params, cache, cur, lens,
                                           ep_mesh)
-        key, sub = jax.random.split(key)
-        # budget-aware mask: a token is legal only if the document can
-        # still complete within the remaining budget after taking it
-        # (dist of the successor state; matches DFAGrammar.constraint)
-        nxt_states = next_t[states]                       # [B, V]
-        fits = dist_t[nxt_states] <= (remaining - 2)[:, None]
-        rows = allow_t[states] & fits
-        sampled = sample_tokens_masked(logits, sub, sampling, rows)
-        # empty row (sub-minimal budget, guarded at submit): force close
-        nxt = jnp.where(rows.any(axis=-1), sampled, close_t[states])
-        nxt = jnp.where(complete_t[states], eos_id, nxt)
-        newly_done = done | (nxt == eos_id)
-        advance = jnp.logical_not(done)
-        cur = jnp.where(advance, nxt, cur)
-        lens = lens + advance.astype(jnp.int32)
-        step_dfa = advance & (nxt != eos_id)
-        states = jnp.where(step_dfa, next_t[states, nxt], states)
-        remaining = remaining - advance.astype(jnp.int32)
-        return (cache, cur, lens, newly_done, states, remaining, key), cur
+        cur, lens, done, states, remaining, key = dfa_scan_step(
+            logits, cur, lens, done, states, remaining, key, sampling,
+            eos_id, allow_t, next_t, dist_t, close_t, complete_t)
+        return (cache, cur, lens, done, states, remaining, key), cur
 
     done0 = jnp.zeros_like(cur_tokens, dtype=bool)
     (cache, _, lengths, _, states, _, _), toks = jax.lax.scan(

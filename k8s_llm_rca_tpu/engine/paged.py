@@ -570,6 +570,40 @@ def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
     return pool, toks, lengths
 
 
+def paged_decode_scan_dfa(cfg: ModelConfig, params, pool: PagePool,
+                          cur_tokens: jnp.ndarray, lengths: jnp.ndarray,
+                          block_tables: jnp.ndarray, key, n_steps: int,
+                          sampling: SamplingParams, eos_id: int,
+                          states: jnp.ndarray, remaining: jnp.ndarray,
+                          allow_t: jnp.ndarray, next_t: jnp.ndarray,
+                          dist_t: jnp.ndarray, close_t: jnp.ndarray,
+                          complete_t: jnp.ndarray,
+                          use_kernel: Optional[bool] = None, ep_mesh=None):
+    """``paged_decode_scan`` with the compiled grammar DFA riding inside
+    the scan (mirrors engine.decode_scan_dfa: budget-aware mask, sample,
+    state transition — all gathers on device).  Returns
+    (pool', tokens [n_steps, B], lengths', states')."""
+
+    from k8s_llm_rca_tpu.engine.engine import dfa_scan_step
+
+    def body(carry, _):
+        pool, cur, lens, done, states, remaining, key = carry
+        pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
+                                         block_tables,
+                                         use_kernel=use_kernel,
+                                         ep_mesh=ep_mesh)
+        cur, lens, done, states, remaining, key = dfa_scan_step(
+            logits, cur, lens, done, states, remaining, key, sampling,
+            eos_id, allow_t, next_t, dist_t, close_t, complete_t)
+        return (pool, cur, lens, done, states, remaining, key), cur
+
+    done0 = jnp.zeros_like(cur_tokens, dtype=bool)
+    (pool, _, lengths, _, states, _, _), toks = jax.lax.scan(
+        body, (pool, cur_tokens, lengths, done0, states, remaining, key),
+        None, length=n_steps)
+    return pool, toks, lengths, states
+
+
 # ---------------------------------------------------------------------------
 # engine
 # ---------------------------------------------------------------------------
@@ -734,6 +768,11 @@ class PagedInferenceEngine(EngineBase):
             functools.partial(paged_decode_scan, ep_mesh=ep_mesh),
             static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))
+        self._dfa_scan = True
+        self._decode_scan_dfa = jax.jit(
+            functools.partial(paged_decode_scan_dfa, ep_mesh=ep_mesh),
+            static_argnums=(0, 7, 8, 9),
+            donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_multi = jax.jit(
             functools.partial(paged_decode_multi, ep_mesh=ep_mesh),
             static_argnums=0, donate_argnums=donate)
@@ -879,19 +918,37 @@ class PagedInferenceEngine(EngineBase):
     def _scan_tick(self, chunk: int, active_slots) -> List[SequenceResult]:
         """Commit ``chunk`` paged decode steps from one on-device scan;
         accounting identical to the stepwise tick (shared commit loop)."""
+        tables = self._active_dfa_tables()
         self._key, sub = jax.random.split(self._key)
-        with METRICS.timer("engine.decode_step"):
-            self.pool, toks, _ = self._decode_scan(
-                self.model_cfg, self.params, self.pool,
-                jnp.asarray(self.cur_tokens, jnp.int32),
-                jnp.asarray(self.lengths, jnp.int32),
-                jnp.asarray(self.block_tables), sub, chunk, self.sampling,
-                self.tokenizer.eos_id, use_kernel=self.use_kernel)
+        if tables is None:
+            with METRICS.timer("engine.decode_step"):
+                self.pool, toks, _ = self._decode_scan(
+                    self.model_cfg, self.params, self.pool,
+                    jnp.asarray(self.cur_tokens, jnp.int32),
+                    jnp.asarray(self.lengths, jnp.int32),
+                    jnp.asarray(self.block_tables), sub, chunk,
+                    self.sampling, self.tokenizer.eos_id,
+                    use_kernel=self.use_kernel)
+        else:
+            allow_t, next_t, dist_t, close_t, complete_t, _ = \
+                self._dfa_device_tables(tables)
+            states, remaining = self._dfa_scan_vectors(tables)
+            with METRICS.timer("engine.decode_step"):
+                self.pool, toks, _, _ = self._decode_scan_dfa(
+                    self.model_cfg, self.params, self.pool,
+                    jnp.asarray(self.cur_tokens, jnp.int32),
+                    jnp.asarray(self.lengths, jnp.int32),
+                    jnp.asarray(self.block_tables), sub, chunk,
+                    self.sampling, self.tokenizer.eos_id,
+                    jnp.asarray(states), jnp.asarray(remaining),
+                    allow_t, next_t, dist_t, close_t, complete_t,
+                    use_kernel=self.use_kernel)
         toks_host = np.asarray(toks)                    # [chunk, B]
 
         def post_commit(slot: int, token: int) -> None:
             self.lengths[slot] += 1
             self.cur_tokens[slot] = token
+            self._grammar_post_commit(slot, token)
 
         return self._commit_scanned(active_slots, toks_host, chunk,
                                     post_commit)

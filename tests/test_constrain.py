@@ -484,3 +484,29 @@ class TestCompiledDFA:
         parsed = json.loads(res.text)
         assert parsed["DestinationKind"] in KINDS
         assert res.completion_tokens <= budget
+
+    def test_paged_engine_chunked_scan_matches_stepwise(self):
+        """The DFA scan also runs on the PAGED engine (chunk bounded by
+        page boundaries): chunked greedy output == stepwise output."""
+        outs = {}
+        tok = get_tokenizer()
+        cfg = TINY.replace(max_seq_len=512)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        for chunk in (1, 8):
+            ecfg = EngineConfig(max_batch=2, max_seq_len=512, paged=True,
+                                page_size=16, num_pages=80,
+                                prefill_buckets=(32,), max_new_tokens=256,
+                                temperature=0.0, decode_chunk=chunk)
+            eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                       use_kernel=False)
+            ids = [eng.submit(tok.encode(p, add_bos=True),
+                              grammar=make_grammar(PLAN_SCHEMA, tok),
+                              max_new_tokens=256)
+                   for p in ("plan a", "plan b")]
+            res = {r.seq_id: r for r in eng.run_to_completion()}
+            outs[chunk] = [res[i].text for i in ids]
+            for text in outs[chunk]:
+                parsed = json.loads(text)
+                assert parsed["DestinationKind"] in KINDS
+            eng.allocator.check()
+        assert outs[1] == outs[8]
