@@ -76,9 +76,18 @@ def build_service(args) -> AssistantService:
 
         params = quantize_params(
             params, bits=4 if getattr(args, "int4", False) else 8)
+    # the CLI default (2048) may exceed a small preset's RoPE table; clamp
+    # so `--backend engine` works out of the box for every --model
+    max_seq = min(args.max_seq_len, model_cfg.max_seq_len)
+    if max_seq < args.max_seq_len:
+        from k8s_llm_rca_tpu.utils.logging import get_logger
+
+        get_logger(__name__).warning(
+            "clamping --max-seq-len %d to %s's model maximum %d",
+            args.max_seq_len, model_cfg.name, max_seq)
     engine = make_engine(
         model_cfg,
-        EngineConfig(max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+        EngineConfig(max_batch=args.max_batch, max_seq_len=max_seq,
                      paged=getattr(args, "paged", False),
                      kv_cache_dtype=getattr(args, "kv_dtype", None)),
         params, tokenizer)

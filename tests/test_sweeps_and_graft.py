@@ -97,3 +97,33 @@ def test_run_file_replicated_engine(tmp_path):
     assert sum(r["incidents"] for r in reps) == 2
     devs = {r["device"] for r in reps}
     assert len(devs) == 2              # round-robin actually pinned 2 devices
+
+
+def test_stage_harnesses(capsys):
+    """The four stage-isolated operator harnesses (the reference's
+    test_find_metapath/test_generate_query/test_check_state/test_token
+    equivalents) each run hermetically and print a JSON result."""
+    import json as _json
+
+    from k8s_llm_rca_tpu.sweeps import stage
+
+    out = stage.main(["locate"])
+    assert out["srcKind"] == "Pod"
+    assert out["plan"]["DestinationKind"] == "Secret"
+    assert ["Pod", "Secret"] in out["metapaths"]
+
+    out = stage.main(["cypher"])
+    assert out["records"] >= 1 and out["human_records"] >= 1
+    assert "MATCH" in out["human_cypher_query"]
+
+    out = stage.main(["audit"])
+    assert out["entity"] == "Secret(sec-0001)"
+    assert any("apparent error" in c for c in out["clues"])
+
+    out = stage.main(["token"])
+    assert out["run_status"] == "completed"
+    assert out["token_usage"]["total_tokens"] > 0
+    # every harness printed a JSON document (last one is parseable as-is)
+    printed = capsys.readouterr().out.strip()
+    assert printed.endswith("}")
+    _json.loads(printed[printed.rindex("\n{"):])
