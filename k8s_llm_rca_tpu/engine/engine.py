@@ -576,8 +576,10 @@ class InferenceEngine(EngineBase):
         then runs context-parallel over it (long-context mode; the axis
         size must divide every prefill bucket and max_seq_len, validated
         below).  ``cp_mode``: "ring" (ppermute KV rotation) or "ulysses"
-        (head<->seq all-to-all).  Decode is unaffected (its per-step KV is
-        one token).
+        (head<->seq all-to-all).  The KV cache is placed SEQUENCE-sharded
+        over the same axis, so each device stores 1/P of a long context's
+        KV; decode runs over the sharded cache via GSPMD-partitioned
+        attention (combine collectives inserted per step).
 
         ``ep_mesh``: optional Mesh with "data" and "expert" axes — every
         MoE MLP (prefill AND decode) dispatches through the all-to-all
@@ -587,6 +589,11 @@ class InferenceEngine(EngineBase):
         below)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
+        if cp_mesh is not None and tp_mesh is not None:
+            # the cache can take ONE distributed layout; composing the two
+            # would silently drop the promised seq-sharding (and the CP
+            # prefill path is not TP-aware)
+            raise ValueError("cp_mesh and tp_mesh are mutually exclusive")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],
@@ -629,6 +636,21 @@ class InferenceEngine(EngineBase):
                 llama.KVCache(kv_spec, kv_spec,
                               _P(None, "data", None), _P(None, "data", None)),
                 tp_mesh)
+        elif cp_mesh is not None:
+            # context-parallel serving: the cache's SEQUENCE axis shards
+            # over the CP mesh, so a context too large for one chip's HBM
+            # spreads its KV across the ring.  Prefill already computes
+            # context-parallel (ring/Ulysses); decode needs no custom
+            # kernel — GSPMD partitions the attention reduction over S
+            from k8s_llm_rca_tpu.runtime.sharding import (
+                kv_cache_cp_specs, shard_pytree,
+            )
+
+            kv_spec, scale_spec = kv_cache_cp_specs(cp_seq_axis)
+            self.cache = shard_pytree(
+                self.cache,
+                llama.KVCache(kv_spec, kv_spec, scale_spec, scale_spec),
+                cp_mesh)
         self.lengths = jnp.zeros((b,), jnp.int32)
         self.cur_tokens = jnp.zeros((b,), jnp.int32)
         self._key = jax.random.PRNGKey(engine_cfg.seed)

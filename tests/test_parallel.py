@@ -720,3 +720,86 @@ def test_pp_decode_under_jit_with_sharded_cache(cpu_devices):
     assert bool(jnp.isfinite(logits).all())
     shard_shape = cache.k.sharding.shard_shape(cache.k.shape)
     assert shard_shape[0] == cfg.n_layers // 2      # layers over stages
+
+
+def test_cp_decode_with_seq_sharded_cache(cpu_devices):
+    """Context-parallel DECODE: with the KV cache's sequence axis sharded
+    over the seq mesh, plain decode_step produces the exact greedy tokens
+    of the unsharded path — GSPMD partitions the attention reduction over
+    S and inserts the combine collectives.  This is the long-context
+    serving half that complements CP prefill: each device holds 1/P of
+    the context's KV bytes."""
+    from jax.sharding import NamedSharding
+    from k8s_llm_rca_tpu.runtime.sharding import kv_cache_cp_specs
+
+    cfg = TINY.replace(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(seq=4), devices=cpu_devices[:4])
+    b = 2
+    prompts = jax.random.randint(jax.random.PRNGKey(1), (b, 16), 0,
+                                 cfg.vocab_size)
+    lengths = jnp.asarray([16, 12], jnp.int32)
+    cache = llama.init_cache(cfg, b, cfg.max_seq_len)
+    cache, logits = llama.prefill_batch(cfg, params, cache, prompts,
+                                        lengths, jnp.arange(b))
+    kv_spec, _ = kv_cache_cp_specs()
+    sharded = llama.KVCache(
+        jax.device_put(cache.k, NamedSharding(mesh, kv_spec)),
+        jax.device_put(cache.v, NamedSharding(mesh, kv_spec)))
+
+    step = jax.jit(llama.decode_step, static_argnums=0)
+    cur = r_cur = jnp.argmax(logits, -1).astype(jnp.int32)
+    lens = lengths
+    cp_cache, ref_cache = sharded, cache
+    for _ in range(6):
+        ref_cache, ref_lg = step(cfg, params, ref_cache, r_cur, lens)
+        cp_cache, cp_lg = step(cfg, params, cp_cache, cur, lens)
+        r_cur = jnp.argmax(ref_lg, -1).astype(jnp.int32)
+        cur = jnp.argmax(cp_lg, -1).astype(jnp.int32)
+        np.testing.assert_array_equal(np.asarray(cur), np.asarray(r_cur))
+        lens = lens + 1
+    # the cache stayed sequence-sharded across steps
+    shard = cp_cache.k.sharding.shard_shape(cp_cache.k.shape)
+    assert shard[2] == cfg.max_seq_len // 4
+
+
+def test_cp_engine_decodes_with_sharded_cache(cpu_devices):
+    """The CP engine now places its cache sequence-sharded: greedy output
+    matches the plain engine while each device stores 1/P of the KV."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(seq=4), devices=cpu_devices[:4])
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok).generate(
+        prompts, max_new_tokens=6)
+    eng = make_engine(cfg, ecfg, params, tok, cp_mesh=mesh)
+    got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+    shard = eng.cache.k.sharding.shard_shape(eng.cache.k.shape)
+    assert shard[2] == ecfg.max_seq_len // 4
+
+
+def test_cp_and_tp_mesh_mutually_exclusive(cpu_devices):
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=1, model=2, seq=2),
+                      devices=cpu_devices[:4])
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, prefill_buckets=(16,))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        InferenceEngine(cfg, ecfg, llama.init_params(cfg,
+                                                     jax.random.PRNGKey(0)),
+                        get_tokenizer(), cp_mesh=mesh, tp_mesh=mesh)
