@@ -68,12 +68,16 @@ def params_multi_device(params) -> bool:
     return False
 
 
-def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg) -> None:
+def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh=None) -> None:
     """TP cache-sharding preconditions: the merged kv axis splits over
     "model" head-aligned (see runtime.sharding.kv_cache_specs) and the
-    slot batch over "data"."""
+    slot batch over "data".  CP+TP in one engine is unsupported — the
+    cache can take only one distributed layout and the CP prefill path is
+    not TP-aware."""
     if tp_mesh is None:
         return
+    if cp_mesh is not None:
+        raise ValueError("cp_mesh and tp_mesh are mutually exclusive")
     for axis in ("data", "model"):
         if axis not in tp_mesh.shape:
             raise ValueError(f"tp_mesh needs a '{axis}' axis, has "
@@ -589,18 +593,13 @@ class InferenceEngine(EngineBase):
         below)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
-        if cp_mesh is not None and tp_mesh is not None:
-            # the cache can take ONE distributed layout; composing the two
-            # would silently drop the promised seq-sharding (and the CP
-            # prefill path is not TP-aware)
-            raise ValueError("cp_mesh and tp_mesh are mutually exclusive")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],
                 tuple(engine_cfg.prefill_buckets)
                 + (engine_cfg.max_seq_len,))
         validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
-        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg)
+        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh)
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params

@@ -644,7 +644,7 @@ class PagedInferenceEngine(EngineBase):
             params_multi_device, validate_ep_mesh, validate_tp_mesh,
         )
         validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
-        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg)
+        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh)
         if use_kernel and (tp_mesh is not None or params_multi_device(params)):
             # pallas_call has no SPMD partitioning rule: the paged kernel
             # would silently replicate per-device instead of sharding

@@ -169,14 +169,17 @@ def test_pipeline_on_real_engine_backend_is_crash_safe():
     assert not engine.has_work
 
 
-def test_incident_completes_on_engine_backend():
+@pytest.mark.parametrize("paged", [False, True])
+def test_incident_completes_on_engine_backend(paged):
     """VERDICT r1 item 3: the full pipeline on the REAL engine with random
     weights must COMPLETE — not merely fail gracefully.  Stage 1 is
     schema-constrained to the kind vocabulary (structured outputs), so the
     plan always names real kinds; stage 2 falls back to the deterministic
     compiler; stage 3 audits are free text.  Content is garbage, structure
     is valid (the reference needs GPT-4 for the same guarantee,
-    find_srckind_metapath_neo4j.py:20-45)."""
+    find_srckind_metapath_neo4j.py:20-45).  Runs on BOTH engines — the
+    paged variant exercises prefix caching (shared audit prefixes) and the
+    DFA scan through the whole agent loop."""
     import jax
 
     from k8s_llm_rca_tpu.config import TINY, EngineConfig, RCAConfig
@@ -187,11 +190,14 @@ def test_incident_completes_on_engine_backend():
     cfg = TINY.replace(max_seq_len=4096)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    paged_kw = dict(paged=True, page_size=64, num_pages=420,
+                    decode_chunk=8) if paged else {}
+    extra = dict(use_kernel=False) if paged else {}
     engine = make_engine(
         cfg, EngineConfig(max_batch=4, max_seq_len=4096,
                           prefill_buckets=(512, 1024, 2048, 4096),
-                          max_new_tokens=96, temperature=0.0),
-        params, tok)
+                          max_new_tokens=96, temperature=0.0, **paged_kw),
+        params, tok, **extra)
     pipeline = RCAPipeline(
         AssistantService(EngineBackend(engine)),
         InMemoryGraphExecutor(build_metagraph()),
@@ -214,6 +220,14 @@ def test_incident_completes_on_engine_backend():
             assert isinstance(audited["report"], str)
             assert isinstance(audited["clue"], dict)
     assert not engine.has_work
+    if paged:
+        engine.allocator.check()       # allocator-internal invariants
+        # true no-leak check: after drain, every owned page belongs to the
+        # prefix cache (retired sequences freed or transferred theirs)
+        resident = engine.prefix_cache.n_resident if engine.prefix_cache \
+            else 0
+        assert engine.allocator.n_free + resident \
+            == engine.engine_cfg.num_pages - 1
 
 
 def test_auditor_rejects_label_injection():
