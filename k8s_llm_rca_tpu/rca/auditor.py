@@ -52,6 +52,30 @@ object looks unrelated; keep each reply a concise list of concrete clues
 with resource names and numbers."""
 
 
+def report_schema() -> dict:
+    """Structured-output schema for the final report: the exact JSON shape
+    the reference's summary prompt demands (reference
+    check_state/analyze_root_cause.py:119-139) — per-kind relevance scores
+    0-10, a conclusion, and a resolution.  Constrained decode makes the
+    shape a guarantee instead of a hope: every report parses, for any
+    model.  The field lengths are sized so the compiled DFA fits the
+    table budget even at 32k-token vocabularies (state count scales with
+    the summed string max_lens; oversized schemas still work — they fall
+    back to the interpreted FSM, off the on-device scan path)."""
+    free = {"type": "string", "max_len": 160}
+    return {"type": "object", "properties": [
+        ("summary", {"type": "array", "min_items": 1, "max_items": 4,
+                     "items": {"type": "object", "properties": [
+                         ("kind", {"type": "string", "max_len": 40}),
+                         ("explanation", {"type": "string", "max_len": 120}),
+                         ("relevance_score",
+                          {"enum": [str(i) for i in range(11)]}),
+                     ]}}),
+        ("conclusion", free),
+        ("resolution", free),
+    ]}
+
+
 def setup_state_semantic_analyzer(service: AssistantService,
                                   model: str = "local",
                                   max_new_tokens: int = 512) -> GenericAssistant:
@@ -62,6 +86,15 @@ def setup_state_semantic_analyzer(service: AssistantService,
     analyzer.create_thread()
     analyzer.add_message(STATE_RULE)
     analyzer.add_message(TASK_PROTOCOL)
+    # the summary run uses a SEPARATE assistant whose decode is schema-
+    # constrained to the report shape; it runs ON the analyzer's thread so
+    # it sees every audit exchange (the per-entity audits stay free text)
+    reporter = GenericAssistant(service)
+    reporter.create_assistant(
+        ANALYZER_INSTRUCTIONS, "k8s-rca-reporter", model,
+        gen=GenOptions(max_new_tokens=max(max_new_tokens, 192),
+                       grammar=report_schema()))
+    analyzer.reporter = reporter
     return analyzer
 
 
@@ -387,6 +420,13 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
         "the actual resource names and namespaces for precision.  Include "
         "crucial details (resource names, IDs, numbers).\n" + REPORT_SHAPE)
     analyzer.add_message(prompt)
+    reporter = getattr(analyzer, "reporter", None)
+    service = analyzer.service
+    if reporter is not None:
+        # schema-constrained summary run on the ANALYZER's thread: same
+        # evidence, guaranteed report shape
+        run = service.create_run(analyzer.thread.id, reporter.assistant.id)
+        return await_semantic(run, analyzer), path_clues
     analyzer.run_assistant()
     messages = analyzer.wait_get_last_k_message(1)
     if messages is None:

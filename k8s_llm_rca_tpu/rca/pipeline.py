@@ -200,4 +200,10 @@ class RCAPipeline:
         u3 = self.service.assistant_token_usage(
             self.analyzer.assistant.id, tmin, tmax,
             sweep.analyzer_usage_limit)
-        return {k: u1[k] + u2[k] + u3[k] for k in u1}
+        usages = [u1, u2, u3]
+        reporter = getattr(self.analyzer, "reporter", None)
+        if reporter is not None:       # the schema-constrained summary runs
+            usages.append(self.service.assistant_token_usage(
+                reporter.assistant.id, tmin, tmax,
+                sweep.analyzer_usage_limit))
+        return {k: sum(u[k] for u in usages) for k in u1}

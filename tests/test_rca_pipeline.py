@@ -217,7 +217,12 @@ def test_incident_completes_on_engine_backend(paged):
         assert "extend_metapath" in analysis
         assert "cypher_attempts" in analysis
         for audited in analysis["statepath"]:
-            assert isinstance(audited["report"], str)
+            # the reporter's schema grammar guarantees the report parses in
+            # the reference shape even from random weights
+            report = json.loads(audited["report"])
+            assert {"summary", "conclusion", "resolution"} <= set(report)
+            for item in report["summary"]:
+                assert item["relevance_score"] in {str(i) for i in range(11)}
             assert isinstance(audited["clue"], dict)
     assert not engine.has_work
     if paged:
