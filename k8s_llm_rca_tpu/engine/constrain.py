@@ -50,6 +50,9 @@ HEX = DIGITS + "abcdefABCDEF"
 _STRING_CHARS = "".join(
     chr(c) for c in range(0x20, 0x7F) if chr(c) not in '"\\')
 _ESCAPABLE = '"\\/bfnrtu'
+# schema strings: the \u hex form is excluded (it would add 4 hex states
+# per position); the named escapes cover quoted commands and JSON payloads
+_SCHEMA_ESCAPABLE = '"\\/bfnrt'
 
 
 @dataclass(frozen=True)
@@ -453,7 +456,11 @@ class JsonGrammar:
 # Supported schema nodes (plain dicts):
 #   {"const": "text"}                      literal span (internal use)
 #   {"enum": ["A", "B", ...]}              one of the quoted literals
-#   {"type": "string", "max_len": N}       free string (no escapes)
+#   {"type": "string", "max_len": N,
+#    "escapes": bool}                       free string; escapes=True also
+#                                           admits JSON escape pairs \" \\
+#                                           \/ \b \f \n \r \t (~2x the DFA
+#                                           states for that field)
 #   {"type": "integer", "max_digits": N}   non-negative JSON integer
 #   {"type": "boolean"}                    true | false
 #   {"type": "array", "items": S,
@@ -478,7 +485,12 @@ def _compile_schema(schema: Dict) -> Tuple:
         return ("enum", cands)
     t = schema.get("type")
     if t == "string":
-        return ("str", int(schema.get("max_len", 64)))
+        # escapes=True additionally admits \" \\ \/ \b \f \n \r \t inside
+        # the string (JSON escape pairs; ~2x the DFA states per field, so
+        # it is opt-in per field — fields carrying quoted commands/JSON
+        # need it, short labels don't)
+        return ("str", int(schema.get("max_len", 64)),
+                bool(schema.get("escapes", False)))
     if t == "integer":
         return ("int", int(schema.get("max_digits", 6)))
     if t == "boolean":
@@ -548,7 +560,8 @@ class SchemaAutomaton:
         if kind == "lit":
             self.stack.append(["lit", node[1], 0])
         elif kind == "str":
-            self.stack.append(["str", node[1], 0, False])
+            # [_, max_len, n, opened, esc_pending, escapes_allowed]
+            self.stack.append(["str", node[1], 0, False, False, node[2]])
         elif kind == "enum":
             self.stack.append(["enum", node[1], 0, False])
         elif kind == "int":
@@ -598,14 +611,23 @@ class SchemaAutomaton:
                 self._pop_done()
             return True
 
-        if kind == "str":                   # [_, max_len, n, opened]
+        if kind == "str":           # [_, max_len, n, opened, esc, escapes]
             if not f[3]:
                 if ch == '"':
                     f[3] = True
                     return True
                 return False
+            if f[4]:                        # escape pending: \X pair
+                if ch in _SCHEMA_ESCAPABLE:
+                    f[4] = False
+                    f[2] += 1
+                    return True
+                return False
             if ch == '"':
                 self._pop_done()
+                return True
+            if ch == "\\" and f[5] and f[2] < f[1]:
+                f[4] = True
                 return True
             if ch in _STRING_CHARS and f[2] < f[1]:
                 f[2] += 1
@@ -701,7 +723,7 @@ class SchemaAutomaton:
         if kind == "lit":
             return f[1][f[2]]
         if kind == "str":
-            return '"'
+            return "n" if f[4] else '"'     # finish a pending escape first
         if kind == "enum":
             if not f[3]:
                 return '"'

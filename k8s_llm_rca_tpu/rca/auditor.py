@@ -62,17 +62,21 @@ def report_schema() -> dict:
     table budget even at 32k-token vocabularies (state count scales with
     the summed string max_lens; oversized schemas still work — they fall
     back to the interpreted FSM, off the on-device scan path)."""
-    free = {"type": "string", "max_len": 160}
+    # conclusion/resolution carry quoted kubectl commands and JSON patches,
+    # so they admit escape pairs (\" etc.); the short per-kind fields don't
+    # (escapes ~double a field's DFA states)
     return {"type": "object", "properties": [
         ("summary", {"type": "array", "min_items": 1, "max_items": 4,
                      "items": {"type": "object", "properties": [
                          ("kind", {"type": "string", "max_len": 40}),
-                         ("explanation", {"type": "string", "max_len": 120}),
+                         ("explanation", {"type": "string", "max_len": 100}),
                          ("relevance_score",
                           {"enum": [str(i) for i in range(11)]}),
                      ]}}),
-        ("conclusion", free),
-        ("resolution", free),
+        ("conclusion",
+         {"type": "string", "max_len": 140, "escapes": True}),
+        ("resolution",
+         {"type": "string", "max_len": 200, "escapes": True}),
     ]}
 
 

@@ -510,3 +510,51 @@ class TestCompiledDFA:
                 assert parsed["DestinationKind"] in KINDS
             eng.allocator.check()
         assert outs[1] == outs[8]
+
+    def test_schema_string_escapes(self):
+        """Opt-in escape pairs in schema strings: quoted kubectl/JSON
+        content is expressible where the field declares escapes=True,
+        rejected where it doesn't."""
+        from k8s_llm_rca_tpu.engine.constrain import DFAGrammar
+
+        schema = {"type": "object", "properties": [
+            ("plain", {"type": "string", "max_len": 10}),
+            ("cmd", {"type": "string", "max_len": 60, "escapes": True})]}
+        ok = ('{"plain": "abc", '
+              '"cmd": "kubectl -p \'{\\"a\\": \\"b\\"}\'"}')
+        a = schema_feed(schema, ok)
+        assert a is not None and a.complete
+        import json as _json
+
+        assert _json.loads(ok)["cmd"].count('"') == 4
+        # a backslash in the non-escaping field is illegal
+        assert schema_feed(schema, '{"plain": "a\\\\') is None
+        # a lone backslash escapes the closing quote: the string (and the
+        # document) must remain open
+        dangling = schema_feed(schema, '{"plain": "abc", "cmd": "x\\"}')
+        assert dangling is not None and not dangling.complete
+        # an escaped backslash then quote closes it: valid JSON
+        closed = schema_feed(schema, '{"plain": "abc", "cmd": "x\\\\"}')
+        assert closed is not None and closed.complete
+        # the DFA path accepts the same document
+        tok = get_tokenizer()
+        g = DFAGrammar(schema, tok)
+        for t in tok.encode(ok):
+            g.advance(t)
+        assert g.done
+
+    def test_report_schema_fits_32k_vocab_budget(self):
+        """The RCA report schema must stay compilable to an on-device DFA
+        at production vocab sizes (the on-device guarantee in docs/rca.md
+        depends on it)."""
+        from k8s_llm_rca_tpu.engine.constrain import (
+            _DFA_MAX_TABLE_BYTES, _compile_schema, _enumerate_char_dfa,
+        )
+        from k8s_llm_rca_tpu.rca.auditor import report_schema
+
+        tok = get_tokenizer()
+        strings = [tok.decode([t]) for t in range(tok.vocab_size)]
+        alphabet = sorted(set("".join(strings)))
+        cn, _ = _enumerate_char_dfa(_compile_schema(report_schema()),
+                                    alphabet, max_states=10**6)
+        assert cn.shape[0] <= _DFA_MAX_TABLE_BYTES // (5 * 32000)
