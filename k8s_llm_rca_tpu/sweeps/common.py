@@ -30,6 +30,11 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
                              f"{sorted(MODEL_REGISTRY)}")
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-seq-len", type=int, default=2048)
+    parser.add_argument("--decode-chunk", type=int, default=None,
+                        help="decode steps per device dispatch (default: "
+                             "EngineConfig's; semantics identical to "
+                             "stepwise — amortizes dispatch latency, and "
+                             "DFA-grammar runs ride the scan)")
     parser.add_argument("--paged", action="store_true",
                         help="paged KV cache engine (preemption + prefix "
                              "caching) instead of contiguous slots")
@@ -85,12 +90,13 @@ def build_service(args) -> AssistantService:
         get_logger(__name__).warning(
             "clamping --max-seq-len %d to %s's model maximum %d",
             args.max_seq_len, model_cfg.name, max_seq)
-    engine = make_engine(
-        model_cfg,
-        EngineConfig(max_batch=args.max_batch, max_seq_len=max_seq,
-                     paged=getattr(args, "paged", False),
-                     kv_cache_dtype=getattr(args, "kv_dtype", None)),
-        params, tokenizer)
+    ecfg_kw = dict(max_batch=args.max_batch, max_seq_len=max_seq,
+                   paged=getattr(args, "paged", False),
+                   kv_cache_dtype=getattr(args, "kv_dtype", None))
+    if getattr(args, "decode_chunk", None) is not None:
+        ecfg_kw["decode_chunk"] = args.decode_chunk   # else EngineConfig's
+    engine = make_engine(model_cfg, EngineConfig(**ecfg_kw),
+                         params, tokenizer)
     return AssistantService(EngineBackend(engine))
 
 
