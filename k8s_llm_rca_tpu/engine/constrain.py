@@ -1062,7 +1062,16 @@ def compile_schema_dfa(schema: Dict, tokenizer: Tokenizer) -> DFATables:
         ch = alphabet[close_col[s]]
         tid = char_token.get(ch)
         if tid is None:
-            tid = tokenizer.encode(ch)[0]
+            # No exact single-char vocab token for this closing char: a
+            # multi-char encode() fallback could land the scan's force-close
+            # on a token whose extra chars derail the DFA (worst case the
+            # state maps to FREE and the slot decodes unconstrained while the
+            # host-side advance raises mid-serve).  Refuse to compile;
+            # make_grammar falls back to the interpreted SchemaGrammar,
+            # which force-closes char-by-char on the host.
+            raise ValueError(
+                f"closing char {ch!r} has no single-char vocab token; "
+                f"schema DFA cannot force-close safely")
         close_tok[s] = tid
 
     # singleton states (literal spans): exactly one legal token -> the
@@ -1096,20 +1105,40 @@ def _dfa_cache_get(schema: Dict, tokenizer: Tokenizer) -> DFATables:
     costs seconds; serving reuses one schema for thousands of runs)."""
     import json as _json
 
-    key = _json.dumps(schema, sort_keys=True, default=str)
+    # no default=str: two distinct non-serializable values whose str() forms
+    # collide would alias to one compiled table set.  A non-serializable
+    # schema refuses here (as ValueError so make_grammar's interpreted-FSM
+    # fallback applies; SchemaGrammar coerces values itself)
+    try:
+        key = _json.dumps(schema, sort_keys=True)
+    except TypeError as e:
+        raise ValueError(f"schema is not canonically JSON-serializable: {e}")
     cache = getattr(tokenizer, "_dfa_tables_cache", None)
     if cache is None:
         cache = {}
         tokenizer._dfa_tables_cache = cache
     tables = cache.get(key)
-    if tables is None:
+    if isinstance(tables, str):
+        raise ValueError(tables)          # negative-cached compile refusal
+    if tables is not None:
+        return tables
+    try:
         tables = compile_schema_dfa(schema, tokenizer)
-        # bound the cache: a server fed ever-changing schemas must not
-        # accumulate multi-MB table sets forever (FIFO eviction; dict
-        # preserves insertion order)
-        while len(cache) >= 8:
-            cache.pop(next(iter(cache)))
-        cache[key] = tables
+    except ValueError as e:
+        # negative-cache refusals too: an uncompilable schema (state
+        # blowup, vocab missing a closer token) must not re-pay the full
+        # BFS + token lift on every request before falling back.  Store
+        # the message only — the live exception's traceback would pin the
+        # partially-built [S, V] compile arrays in the cache
+        tables = str(e)
+    # bound the cache: a server fed ever-changing schemas must not
+    # accumulate multi-MB table sets (or unbounded refusal entries)
+    # forever (FIFO eviction; dict preserves insertion order)
+    while len(cache) >= 8:
+        cache.pop(next(iter(cache)))
+    cache[key] = tables
+    if isinstance(tables, str):
+        raise ValueError(tables)
     return tables
 
 

@@ -10,6 +10,7 @@ engine-side counters (tokens, steps, queue depth) that the sweep drivers and
 from __future__ import annotations
 
 import contextlib
+import statistics
 import threading
 import logging
 import time
@@ -29,6 +30,10 @@ def get_logger(name: str = "k8s_llm_rca_tpu") -> logging.Logger:
         logger.setLevel(logging.INFO)
         logger.propagate = False
     return logger
+
+
+def _median(xs: List[float]) -> float:
+    return float(statistics.median(xs)) if xs else 0.0
 
 
 @dataclass
@@ -59,26 +64,27 @@ class Metrics:
 
     def count(self, name: str) -> float:
         """Current value of an ``inc`` counter (0 if never incremented)."""
-        return self.counters.get(name, 0.0)
+        with self._lock:
+            return self.counters.get(name, 0.0)
 
     def total(self, name: str) -> float:
         """Summed duration of a ``timer`` phase (0 if never timed)."""
-        return sum(self.timings.get(name, []))
+        with self._lock:
+            return sum(self.timings.get(name, []))
 
     def p50(self, name: str) -> float:
-        xs = sorted(self.timings.get(name, []))
-        if not xs:
-            return 0.0
-        n = len(xs)
-        mid = n // 2
-        return xs[mid] if n % 2 == 1 else 0.5 * (xs[mid - 1] + xs[mid])
+        with self._lock:
+            xs = list(self.timings.get(name, []))
+        return _median(xs)
 
     def snapshot(self) -> Dict[str, float]:
-        out = dict(self.counters)
-        for k, v in self.timings.items():
+        with self._lock:
+            out = dict(self.counters)
+            timings = {k: list(v) for k, v in self.timings.items()}
+        for k, v in timings.items():
             out[f"{k}.total_s"] = sum(v)
             out[f"{k}.count"] = float(len(v))
-            out[f"{k}.p50_s"] = self.p50(k)
+            out[f"{k}.p50_s"] = _median(v)
         return out
 
 
