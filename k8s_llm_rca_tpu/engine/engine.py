@@ -133,6 +133,43 @@ def validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh) -> None:
             f"prefix_cache=False")
 
 
+def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
+                     tp_mesh, microbatches: Optional[int],
+                     stage_axis: str = "stage") -> Optional[int]:
+    """PP serving preconditions (shared by both engines).  Returns the
+    resolved microbatch count (None when pp_mesh is None).
+
+    PP is currently exclusive with the other model-parallel axes: the
+    stage-sharded cache layout and the pipelined prefill/decode paths are
+    not TP/EP/CP-aware (composition is a mesh-layout problem the parity
+    tests don't yet cover — fail loudly instead of silently recomputing).
+    Speculative decoding is excluded too: decode_multi has no pipelined
+    equivalent, and _speculation_applies would silently never fire."""
+    if pp_mesh is None:
+        return None
+    for other, name in ((cp_mesh, "cp_mesh"), (ep_mesh, "ep_mesh"),
+                        (tp_mesh, "tp_mesh")):
+        if other is not None:
+            raise ValueError(f"pp_mesh and {name} are mutually exclusive")
+    if stage_axis not in pp_mesh.shape:
+        raise ValueError(f"pp_mesh needs a '{stage_axis}' axis, has "
+                         f"{dict(pp_mesh.shape)}")
+    n_stages = pp_mesh.shape[stage_axis]
+    if model_cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={model_cfg.n_layers} not divisible into "
+            f"{n_stages} pipeline stages")
+    m = microbatches or n_stages
+    if engine_cfg.max_batch % m:
+        raise ValueError(
+            f"max_batch={engine_cfg.max_batch} not divisible into "
+            f"{m} PP microbatches")
+    if engine_cfg.speculative_k > 0:
+        raise ValueError("speculative decoding is unsupported under PP "
+                         "(no pipelined decode_multi); set speculative_k=0")
+    return m
+
+
 def validate_cp_divisibility(cp_seq_axis: str, n_cp: int, sizes) -> None:
     """CP prefill shards the padded sequence over the mesh axis; every
     prefill bucket (and max_seq_len — paged callers pass page-rounded
@@ -191,6 +228,10 @@ class EngineBase:
     # whether _scan_tick can run compiled-DFA grammar slots on device
     # (engine.decode_scan_dfa); the contiguous engine overrides to True
     _dfa_scan: bool = False
+    # pipeline-parallel serving (pp_mesh=): admissions route through the
+    # batched pipelined prefill, padded to _pp_m microbatch multiples
+    _pp: bool = False
+    _pp_m: Optional[int] = None
 
     # -------------------------------------------------------- shared api
 
@@ -575,6 +616,9 @@ class InferenceEngine(EngineBase):
         cp_mode: str = "ring",
         ep_mesh=None,
         tp_mesh=None,
+        pp_mesh=None,
+        pp_microbatches: Optional[int] = None,
+        pp_stage_axis: str = "stage",
     ):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         then runs context-parallel over it (long-context mode; the axis
@@ -600,6 +644,10 @@ class InferenceEngine(EngineBase):
                 + (engine_cfg.max_seq_len,))
         validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
         validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh)
+        self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
+                                      cp_mesh, ep_mesh, tp_mesh,
+                                      pp_microbatches, pp_stage_axis)
+        self._pp = pp_mesh is not None
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.params = params
@@ -650,6 +698,20 @@ class InferenceEngine(EngineBase):
                 self.cache,
                 llama.KVCache(kv_spec, kv_spec, scale_spec, scale_spec),
                 cp_mesh)
+        elif pp_mesh is not None:
+            # PP serving: the cache's LAYER axis shards over "stage" so
+            # each device holds only its stage's layers' KV — the cache
+            # half of the per-stage split (weights below)
+            from k8s_llm_rca_tpu.parallel.pipeline import (
+                kv_cache_stage_specs, kv_scale_stage_specs,
+            )
+            from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
+
+            kv_spec = kv_cache_stage_specs()
+            sc_spec = kv_scale_stage_specs()
+            self.cache = shard_pytree(
+                self.cache,
+                llama.KVCache(kv_spec, kv_spec, sc_spec, sc_spec), pp_mesh)
         self.lengths = jnp.zeros((b,), jnp.int32)
         self.cur_tokens = jnp.zeros((b,), jnp.int32)
         self._key = jax.random.PRNGKey(engine_cfg.seed)
@@ -659,7 +721,38 @@ class InferenceEngine(EngineBase):
         self._pending: List[_Pending] = []
         self._seq_counter = itertools.count()
 
-        if cp_mesh is not None:
+        pp_decode_fn = None
+        if pp_mesh is not None:
+            # PP serving: weights restacked [P, L/P, ...] and sharded over
+            # "stage" (each device holds ONE stage's layers); self.params
+            # becomes (non-layer params, stacked layers) — every PP entry
+            # point unpacks the pair, and the stacked tree travels as a jit
+            # ARGUMENT (a closure would inline the weights as constants).
+            from k8s_llm_rca_tpu.parallel import pipeline as pp
+
+            n_stages = pp_mesh.shape[pp_stage_axis]
+            stacked = pp.shard_stacked_layers(
+                pp.stack_llama_stages(params, n_stages), pp_mesh,
+                pp_stage_axis)
+            light = {k: v for k, v in params.items() if k != "layers"}
+            self.params = (light, stacked)
+            m = self._pp_m
+
+            def _pp_prefill_batch(cfg, params_t, cache, toks, lens, slots):
+                p, stk = params_t
+                return pp.llama_pp_prefill(cfg, p, cache, toks, lens,
+                                           pp_mesh, m, pp_stage_axis, stk,
+                                           slots)
+
+            def pp_decode_fn(cfg, params_t, cache, toks, lens):
+                p, stk = params_t
+                return pp.llama_pp_decode_step(cfg, p, cache, toks, lens,
+                                               pp_mesh, m, pp_stage_axis,
+                                               stk)
+
+            self._prefill = None        # PP admits through the batched path
+            self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0)
+        elif cp_mesh is not None:
             def _prefill_cp(cfg, params, cache, toks, n, slot):
                 return llama.prefill_cp(cfg, params, cache, toks, n, slot,
                                         cp_mesh, cp_seq_axis, cp_mode)
@@ -679,7 +772,8 @@ class InferenceEngine(EngineBase):
         # per-sequence)
         self._batch_admission = cp_mesh is None
         self._decode = jax.jit(
-            functools.partial(llama.decode_step, ep_mesh=ep_mesh),
+            pp_decode_fn if pp_decode_fn is not None
+            else functools.partial(llama.decode_step, ep_mesh=ep_mesh),
             static_argnums=0)
         def _verify_step(cfg, params, cache, tokens, lengths):
             cache, logits = llama.decode_multi(cfg, params, cache, tokens,
@@ -693,11 +787,13 @@ class InferenceEngine(EngineBase):
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
         self._decode_scan = jax.jit(
-            functools.partial(decode_scan, ep_mesh=ep_mesh),
+            functools.partial(decode_scan, ep_mesh=ep_mesh,
+                              decode_fn=pp_decode_fn),
             static_argnums=(0, 6, 7, 8))
         self._dfa_scan = True
         self._decode_scan_dfa = jax.jit(
-            functools.partial(decode_scan_dfa, ep_mesh=ep_mesh),
+            functools.partial(decode_scan_dfa, ep_mesh=ep_mesh,
+                              decode_fn=pp_decode_fn),
             static_argnums=(0, 6, 7, 8))
         self._dfa_dev: Dict[int, tuple] = {}   # id(tables) -> device arrays
         self._prompts: Dict[int, List[int]] = {}   # seq_id -> prompt (for
@@ -719,7 +815,10 @@ class InferenceEngine(EngineBase):
         finished: List[SequenceResult] = []
         while self._pending and self._free_slots:
             group = self._admission_group()
-            if len(group) == 1:
+            # PP has no single-sequence prefill: every admission goes
+            # through the batched pipelined path (padded to a microbatch
+            # multiple in _admit_batch)
+            if len(group) == 1 and not self._pp:
                 early = self._admit(group[0])
                 if early is not None:    # first sampled token already terminal
                     finished.append(early)
@@ -849,6 +948,11 @@ class InferenceEngine(EngineBase):
         n_pad = 1
         while n_pad < n:
             n_pad *= 2
+        if self._pp and n_pad % self._pp_m:
+            # the pipelined prefill microbatches its rows: pad the batch
+            # to a microbatch multiple (rows repeat the last real row, so
+            # the extra scatter writes stay idempotent)
+            n_pad = -(-n_pad // self._pp_m) * self._pp_m
         slots = [self._free_slots.pop(0) for _ in range(n)]
         tokens = np.zeros((n_pad, bucket), np.int32)
         lens = np.zeros((n_pad,), np.int32)
@@ -974,17 +1078,23 @@ def decode_scan(
     sampling: SamplingParams = SamplingParams(),
     eos_id: int = -1,
     ep_mesh=None,
+    decode_fn=None,
 ) -> Tuple[llama.KVCache, jnp.ndarray, jnp.ndarray]:
     """Decode ``n_steps`` for the whole batch with zero host sync.
 
     Returns (cache, tokens [n_steps, B], lengths).  Slots that hit ``eos_id``
     stop advancing (their token repeats; host trims after the fact).
+    ``decode_fn``: optional (cfg, params, cache, tokens, lengths) ->
+    (cache, logits) override — the PP engine scans its pipelined step.
     """
 
     def body(carry, _):
         cache, cur, lens, done, key = carry
-        cache, logits = llama.decode_step(cfg, params, cache, cur, lens,
-                                          ep_mesh)
+        if decode_fn is None:
+            cache, logits = llama.decode_step(cfg, params, cache, cur, lens,
+                                              ep_mesh)
+        else:
+            cache, logits = decode_fn(cfg, params, cache, cur, lens)
         key, sub = jax.random.split(key)
         nxt = sample_tokens(logits, sub, sampling)
         newly_done = done | (nxt == eos_id)
@@ -1044,6 +1154,7 @@ def decode_scan_dfa(
     close_t: jnp.ndarray,       # [S] int32
     complete_t: jnp.ndarray,    # [S] bool
     ep_mesh=None,
+    decode_fn=None,
 ) -> Tuple[llama.KVCache, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """``decode_scan`` with the grammar DFA riding INSIDE the scan.
 
@@ -1057,8 +1168,11 @@ def decode_scan_dfa(
 
     def body(carry, _):
         cache, cur, lens, done, states, remaining, key = carry
-        cache, logits = llama.decode_step(cfg, params, cache, cur, lens,
-                                          ep_mesh)
+        if decode_fn is None:
+            cache, logits = llama.decode_step(cfg, params, cache, cur, lens,
+                                              ep_mesh)
+        else:
+            cache, logits = decode_fn(cfg, params, cache, cur, lens)
         cur, lens, done, states, remaining, key = dfa_scan_step(
             logits, cur, lens, done, states, remaining, key, sampling,
             eos_id, allow_t, next_t, dist_t, close_t, complete_t)

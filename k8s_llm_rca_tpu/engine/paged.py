@@ -540,21 +540,29 @@ def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
                       cur_tokens: jnp.ndarray, lengths: jnp.ndarray,
                       block_tables: jnp.ndarray, key, n_steps: int,
                       sampling: SamplingParams, eos_id: int,
-                      use_kernel: Optional[bool] = None, ep_mesh=None):
+                      use_kernel: Optional[bool] = None, ep_mesh=None,
+                      decode_fn=None):
     """``n_steps`` paged decode steps with zero host sync (the paged
     engine's chunked tick).  Valid only while no sequence crosses a page
     boundary — the caller bounds ``n_steps`` by each slot's distance to
     its next boundary so ``block_tables`` stays static for the whole scan.
 
     Returns (pool', tokens [n_steps, B], lengths').  Slots
-    that hit ``eos_id`` stop advancing (token repeats; host trims)."""
+    that hit ``eos_id`` stop advancing (token repeats; host trims).
+    ``decode_fn``: optional (cfg, params, pool, tokens, lengths,
+    block_tables) -> (pool, logits) override (the PP engine's pipelined
+    step)."""
 
     def body(carry, _):
         pool, cur, lens, done, key = carry
-        pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
-                                         block_tables,
-                                         use_kernel=use_kernel,
-                                         ep_mesh=ep_mesh)
+        if decode_fn is None:
+            pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
+                                             block_tables,
+                                             use_kernel=use_kernel,
+                                             ep_mesh=ep_mesh)
+        else:
+            pool, logits = decode_fn(cfg, params, pool, cur, lens,
+                                     block_tables)
         key, sub = jax.random.split(key)
         nxt = sample_tokens(logits, sub, sampling)
         newly_done = done | (nxt == eos_id)
@@ -578,7 +586,8 @@ def paged_decode_scan_dfa(cfg: ModelConfig, params, pool: PagePool,
                           allow_t: jnp.ndarray, next_t: jnp.ndarray,
                           dist_t: jnp.ndarray, close_t: jnp.ndarray,
                           complete_t: jnp.ndarray,
-                          use_kernel: Optional[bool] = None, ep_mesh=None):
+                          use_kernel: Optional[bool] = None, ep_mesh=None,
+                          decode_fn=None):
     """``paged_decode_scan`` with the compiled grammar DFA riding inside
     the scan (mirrors engine.decode_scan_dfa: budget-aware mask, sample,
     state transition — all gathers on device).  Returns
@@ -588,10 +597,14 @@ def paged_decode_scan_dfa(cfg: ModelConfig, params, pool: PagePool,
 
     def body(carry, _):
         pool, cur, lens, done, states, remaining, key = carry
-        pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
-                                         block_tables,
-                                         use_kernel=use_kernel,
-                                         ep_mesh=ep_mesh)
+        if decode_fn is None:
+            pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
+                                             block_tables,
+                                             use_kernel=use_kernel,
+                                             ep_mesh=ep_mesh)
+        else:
+            pool, logits = decode_fn(cfg, params, pool, cur, lens,
+                                     block_tables)
         cur, lens, done, states, remaining, key = dfa_scan_step(
             logits, cur, lens, done, states, remaining, key, sampling,
             eos_id, allow_t, next_t, dist_t, close_t, complete_t)
@@ -630,7 +643,9 @@ class PagedInferenceEngine(EngineBase):
                  params, tokenizer: Tokenizer,
                  use_kernel: Optional[bool] = None,
                  cp_mesh=None, cp_seq_axis: str = "seq",
-                 cp_mode: str = "ring", ep_mesh=None, tp_mesh=None):
+                 cp_mode: str = "ring", ep_mesh=None, tp_mesh=None,
+                 pp_mesh=None, pp_microbatches: Optional[int] = None,
+                 pp_stage_axis: str = "stage"):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         runs context-parallel over it (ring or Ulysses, as in the
         contiguous engine) and scatters the full-depth KV into pool pages.
@@ -641,10 +656,25 @@ class PagedInferenceEngine(EngineBase):
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
         from k8s_llm_rca_tpu.engine.engine import (
-            params_multi_device, validate_ep_mesh, validate_tp_mesh,
+            params_multi_device, validate_ep_mesh, validate_pp_mesh,
+            validate_tp_mesh,
         )
         validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
         validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh)
+        self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
+                                      cp_mesh, ep_mesh, tp_mesh,
+                                      pp_microbatches, pp_stage_axis)
+        self._pp = pp_mesh is not None
+        if self._pp:
+            if engine_cfg.prefix_cache:
+                raise ValueError(
+                    "pp_mesh requires prefix_cache=False (the chunked "
+                    "prefix prefill path is not pipeline-parallel)")
+            if use_kernel:
+                raise ValueError(
+                    "use_kernel=True is incompatible with pp_mesh (the "
+                    "pipelined decode reads the gathered XLA page view)")
+            use_kernel = False
         if use_kernel and (tp_mesh is not None or params_multi_device(params)):
             # pallas_call has no SPMD partitioning rule: the paged kernel
             # would silently replicate per-device instead of sharding
@@ -715,6 +745,20 @@ class PagedInferenceEngine(EngineBase):
                 self.pool,
                 PagePool(pool_spec, pool_spec, scale_spec, scale_spec),
                 tp_mesh)
+        elif pp_mesh is not None:
+            # PP serving: the pool's LAYER axis shards over "stage" —
+            # each device holds only its stage's layers' pages (the cache
+            # half of the per-stage split; weights below)
+            from k8s_llm_rca_tpu.parallel.pipeline import (
+                kv_cache_stage_specs, kv_scale_stage_specs,
+            )
+            from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
+
+            self.pool = shard_pytree(
+                self.pool,
+                PagePool(kv_cache_stage_specs(), kv_cache_stage_specs(),
+                         kv_scale_stage_specs(), kv_scale_stage_specs()),
+                pp_mesh)
         self.allocator = make_allocator(engine_cfg.num_pages,
                                         engine_cfg.native)
         self.prefix_cache = (PrefixCache(self.allocator, self.page_size)
@@ -738,7 +782,38 @@ class PagedInferenceEngine(EngineBase):
         # every tick copies the whole pool and peak HBM doubles.  (CPU has
         # no donation support and would warn on every compile, so gate it.)
         donate = (2,) if jax.default_backend() == "tpu" else ()
-        if cp_mesh is not None:
+        pp_decode_fn = None
+        if pp_mesh is not None:
+            # PP serving: layers restacked [P, L/P, ...] and sharded over
+            # "stage"; self.params becomes (non-layer params, stacked) —
+            # the stacked tree travels as a jit ARGUMENT, never a closure
+            # (a closure would inline the weights as constants)
+            from k8s_llm_rca_tpu.parallel import pipeline as pp
+
+            n_stages = pp_mesh.shape[pp_stage_axis]
+            stacked = pp.shard_stacked_layers(
+                pp.stack_llama_stages(params, n_stages), pp_mesh,
+                pp_stage_axis)
+            self.params = ({k: v for k, v in params.items()
+                            if k != "layers"}, stacked)
+            m = self._pp_m
+
+            def _pp_prefill_batch(cfg, params_t, pool, toks, lens, maps):
+                p, stk = params_t
+                return pp.paged_pp_prefill(cfg, p, pool, toks, lens, maps,
+                                           pp_mesh, m, pp_stage_axis, stk)
+
+            def pp_decode_fn(cfg, params_t, pool, toks, lens, bt,
+                             use_kernel=None):
+                p, stk = params_t
+                return pp.paged_pp_decode_step(cfg, p, pool, toks, lens, bt,
+                                               pp_mesh, m, pp_stage_axis,
+                                               stk)
+
+            self._prefill = None     # PP admits through the batched path
+            self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0,
+                                          donate_argnums=donate)
+        elif cp_mesh is not None:
             def _prefill_cp(cfg, params, pool, toks, n, page_map):
                 return paged_prefill_cp(cfg, params, pool, toks, n,
                                         page_map, cp_mesh, cp_seq_axis,
@@ -752,25 +827,29 @@ class PagedInferenceEngine(EngineBase):
                                   use_flash=flash_prefill_safe(params),
                                   ep_mesh=ep_mesh),
                 static_argnums=0, donate_argnums=donate)
-        self._prefill_batch = jax.jit(
-            functools.partial(paged_prefill_batch,
-                              use_flash=flash_prefill_safe(params),
-                              ep_mesh=ep_mesh),
-            static_argnums=0, donate_argnums=donate)
+        if pp_mesh is None:
+            self._prefill_batch = jax.jit(
+                functools.partial(paged_prefill_batch,
+                                  use_flash=flash_prefill_safe(params),
+                                  ep_mesh=ep_mesh),
+                static_argnums=0, donate_argnums=donate)
         self._prefill_chunk = jax.jit(
             functools.partial(paged_prefill_chunk, ep_mesh=ep_mesh),
             static_argnums=0, donate_argnums=donate)
         self._decode = jax.jit(
-            functools.partial(paged_decode_step, ep_mesh=ep_mesh),
+            pp_decode_fn if pp_decode_fn is not None
+            else functools.partial(paged_decode_step, ep_mesh=ep_mesh),
             static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_scan = jax.jit(
-            functools.partial(paged_decode_scan, ep_mesh=ep_mesh),
+            functools.partial(paged_decode_scan, ep_mesh=ep_mesh,
+                              decode_fn=pp_decode_fn),
             static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._dfa_scan = True
         self._decode_scan_dfa = jax.jit(
-            functools.partial(paged_decode_scan_dfa, ep_mesh=ep_mesh),
+            functools.partial(paged_decode_scan_dfa, ep_mesh=ep_mesh,
+                              decode_fn=pp_decode_fn),
             static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_multi = jax.jit(
@@ -799,7 +878,10 @@ class PagedInferenceEngine(EngineBase):
         while self._pending and self._free_slots:
             group, matched = self._admission_group()
             try:
-                if len(group) == 1:
+                # PP has no single-sequence prefill: every admission goes
+                # through the batched pipelined path (padded to a
+                # microbatch multiple in _admit_batch)
+                if len(group) == 1 and not self._pp:
                     early = self._admit(group[0], matched)
                     admitted = [early] if early is not None else []
                 else:
@@ -1125,6 +1207,11 @@ class PagedInferenceEngine(EngineBase):
         n_pad = 1
         while n_pad < n:
             n_pad *= 2
+        if self._pp and n_pad % self._pp_m:
+            # the pipelined prefill microbatches its rows: pad to a
+            # microbatch multiple (padding rows repeat the last real row's
+            # tokens AND pages, so duplicate scatter writes stay idempotent)
+            n_pad = -(-n_pad // self._pp_m) * self._pp_m
         tokens = np.zeros((n_pad, bucket), np.int32)
         lens = np.zeros((n_pad,), np.int32)
         maps = np.zeros((n_pad, n_pages), np.int32)

@@ -9,55 +9,71 @@ pods the ``stage`` axis is laid out over DCN while TP stays on ICI
 The stage function is arbitrary (a run of transformer blocks in practice);
 ``pipeline_apply`` is deliberately generic so tests can validate the
 schedule with small closures.
+
+Serving entry points (``llama_pp_prefill``/``llama_pp_decode_step`` for the
+contiguous cache, ``paged_pp_prefill``/``paged_pp_decode_step`` for the page
+pool) share ONE schedule implementation (``_gpipe_loop``); what varies per
+entry point is only the per-stage compute + KV write.  All four support
+quantized KV (int8 / nibble-packed int4, same per-token scalar scales as
+models/llama.KVCache and engine/paged.PagePool).
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _pipeline_local(stage_params, x_mb, fn: Callable, axis_name: str):
-    """Under shard_map: stage_params is this stage's slice (leading stage
-    axis of size 1), x_mb [M, ...] microbatches (replicated)."""
-    n_stages = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
-    params = jax.tree.map(lambda a: a[0], stage_params)
-    m = x_mb.shape[0]
-    ticks = m + n_stages - 1
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+def _gpipe_loop(stage_apply: Callable, x_mb: jnp.ndarray, kv: Tuple,
+                m: int, n_st, my, perm, stage_axis: str):
+    """The GPipe schedule, shared by every pipelined entry point.
 
+    Runs M + P - 1 ticks; at tick t, this stage processes microbatch
+    t - stage_index (clipped; ``valid`` is False on the warmup/drain
+    garbage ticks).  ``stage_apply(h_in, mb_idx, valid, kv) -> (h_out,
+    kv)`` owns the per-stage compute and any KV-cache writes (which must
+    self-mask with ``valid``).  Returns (out [M, ...] = the last stage's
+    per-microbatch outputs broadcast to every device, kv).
+    """
+    ticks = m + n_st - 1
     out_buf = jnp.zeros_like(x_mb)
     cur = jnp.zeros_like(x_mb[0])
 
     def tick(t, carry):
-        cur, out_buf = carry
+        cur, out_buf, kv = carry
+        mb = jnp.clip(t - my, 0, m - 1)
+        valid = jnp.logical_and(t - my >= 0, t - my < m)
         # stage 0 ingests microbatch t (when in range); others use received
         feed = x_mb[jnp.minimum(t, m - 1)]
-        x_in = jnp.where(my == 0, feed, cur)
-        y = fn(params, x_in)
-        # the last stage writes its result for the microbatch finishing here
-        mb_idx = t - (n_stages - 1)
-        write = jnp.logical_and(my == n_stages - 1, mb_idx >= 0)
+        h_in = jnp.where(my == 0, feed, cur)
+        h_out, kv = stage_apply(h_in, mb, valid, kv)
+        # the last stage records its result for the microbatch finishing here
+        mb_done = t - (n_st - 1)
+        write = jnp.logical_and(my == n_st - 1, mb_done >= 0)
         out_buf = jax.lax.cond(
             write,
             lambda b: jax.lax.dynamic_update_index_in_dim(
-                b, y, jnp.maximum(mb_idx, 0), 0),
-            lambda b: b,
-            out_buf)
-        nxt = jax.lax.ppermute(y, axis_name, perm)
-        return nxt, out_buf
+                b, h_out, jnp.maximum(mb_done, 0), 0),
+            lambda b: b, out_buf)
+        cur = jax.lax.ppermute(h_out, stage_axis, perm)
+        return cur, out_buf, kv
 
-    cur, out_buf = jax.lax.fori_loop(0, ticks, tick, (cur, out_buf))
+    cur, out_buf, kv = jax.lax.fori_loop(0, ticks, tick, (cur, out_buf, kv))
     # broadcast the last stage's buffer to every device so the out_spec can
     # be replicated (psum of one-hot contribution)
-    contrib = jnp.where(my == n_stages - 1, out_buf,
-                        jnp.zeros_like(out_buf))
-    return jax.lax.psum(contrib, axis_name)
+    contrib = jnp.where(my == n_st - 1, out_buf, jnp.zeros_like(out_buf))
+    return jax.lax.psum(contrib, stage_axis), kv
+
+
+def _stage_local_init(stage_layers, axis_name: str):
+    n_stages = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], stage_layers)   # strip stage dim
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    return n_stages, my, params, perm
 
 
 def stack_llama_stages(params: Any, n_stages: int) -> Any:
@@ -73,6 +89,19 @@ def stack_llama_stages(params: Any, n_stages: int) -> Any:
         for i in range(n_stages)
     ]
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def shard_stacked_layers(stacked: Any, mesh: Mesh,
+                         stage_axis: str = "stage") -> Any:
+    """Place a ``stack_llama_stages`` tree with its leading stage axis
+    sharded over ``mesh[stage_axis]`` — each device then holds ONLY its
+    stage's layer weights, which is the HBM win that makes PP serve models
+    whose weights exceed one chip.  Serving engines hoist this once."""
+    def _put(x):
+        spec = P(stage_axis, *(None,) * (x.ndim - 1))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(_put, stacked)
 
 
 def llama_pipeline_forward(cfg, params: Any, tokens: jnp.ndarray, mesh: Mesh,
@@ -130,7 +159,17 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     params at index i).  x_mb: [M, ...] microbatches.  Returns [M, ...] =
     stage_{P-1}(... stage_0(x) ...) per microbatch.
     """
-    body = functools.partial(_pipeline_local, fn=fn, axis_name=stage_axis)
+
+    def body(stage_params, x_mb):
+        n_st, my, params, perm = _stage_local_init(stage_params, stage_axis)
+
+        def stage_apply(h, mb_idx, valid, kv):
+            return fn(params, h), kv
+
+        out, _ = _gpipe_loop(stage_apply, x_mb, (), x_mb.shape[0], n_st, my,
+                             perm, stage_axis)
+        return out
+
     return jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(stage_axis), P(*(None,) * x_mb.ndim)),
@@ -144,19 +183,20 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 # ---------------------------------------------------------------------------
 #
 # What makes PP serve-capable is the CACHE split, not just the weights:
-# stage i holds only its layers' weights AND its layers' KV (the KVCache
-# layer axis shards over "stage"), so a model whose weights+cache exceed
+# stage i holds only its layers' weights AND its layers' KV (the cache/pool
+# LAYER axis shards over "stage"), so a model whose weights+cache exceed
 # one device serves across the stage axis — the DCN-friendly scale-out the
-# reference cannot express at all (SURVEY §2.2 PP row).  Both entry points
-# run the GPipe microbatch schedule of ``_pipeline_local``: at tick t,
-# stage s processes microbatch t-s; activations hop stages via ppermute;
-# cache writes are masked to valid (stage, tick) pairs.  Decode pipelines
-# the BATCH (slot groups are the microbatches), so all stages stay busy in
+# reference cannot express at all (SURVEY §2.2 PP row).  All entry points
+# run the GPipe microbatch schedule of ``_gpipe_loop``: at tick t, stage s
+# processes microbatch t-s; activations hop stages via ppermute; cache
+# writes are masked to valid (stage, tick) pairs.  Decode pipelines the
+# BATCH (slot groups are the microbatches), so all stages stay busy in
 # steady state after the P-1 bubble.
 #
-# Scope: full-precision KV only (quantized per-stage scales would need the
-# same masked-write plumbing per scale pool); engines integrate TP/EP/DP
-# first — these entry points are the building blocks and the parity proof.
+# Quantized KV (int8 / packed int4) uses the same per-token scalar scales
+# as the plain paths: quantization happens at the per-stage write, dequant
+# at the per-stage attention read, so PP serving composes with the cache
+# compression that carries the big single-chip configs.
 
 
 def kv_cache_stage_specs() -> P:
@@ -164,27 +204,48 @@ def kv_cache_stage_specs() -> P:
     return P("stage", None, None, None)
 
 
-def _stage_local_init(stage_layers, axis_name: str):
-    n_stages = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
-    params = jax.tree.map(lambda a: a[0], stage_layers)   # strip stage dim
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    return n_stages, my, params, perm
+def kv_scale_stage_specs() -> P:
+    """KVCache/PagePool scales [L, B, S] / [L, pages, page]: layer axis
+    over "stage", like the payload they scale."""
+    return P("stage", None, None)
+
+
+def _kv_tuple(cache) -> Tuple:
+    """Cache/pool -> flat array tuple for shard_map (scales only when
+    quantized, so full-precision paths don't ship None through specs)."""
+    if cache.k_scale is not None:
+        return (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    return (cache.k, cache.v)
+
+
+def _kv_specs(quant: bool) -> Tuple:
+    specs = (kv_cache_stage_specs(), kv_cache_stage_specs())
+    if quant:
+        specs += (kv_scale_stage_specs(), kv_scale_stage_specs())
+    return specs
+
+
+def _rebuild(cache, kv_out: Tuple):
+    if len(kv_out) == 4:
+        return type(cache)(*kv_out)
+    return type(cache)(kv_out[0], kv_out[1], None, None)
 
 
 def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
                      microbatches: int = None, stage_axis: str = "stage",
-                     stacked_layers=None):
+                     stacked_layers=None, slots=None):
     """Pipeline-parallel batched prefill with per-stage KV writes.
 
     tokens [B, S_pad] right-padded, lengths [B]; B divides into
-    ``microbatches`` slot groups (default: one per stage).  Returns
-    (cache', logits [B, V] at each row's last valid token), matching
-    ``llama.prefill_batch`` with slots = arange(B).
+    ``microbatches`` slot groups (default: one per stage); ``slots`` [B]
+    cache rows to write (default arange(B); duplicates allowed only for
+    identical rows — the engines pad admission batches by repeating the
+    last real row, making the duplicate scatter writes idempotent).
+    Returns (cache', logits [B, V] at each row's last valid token),
+    matching ``llama.prefill_batch``.  Supports quantized caches.
     """
     from k8s_llm_rca_tpu.models import llama as L
 
-    assert cache.k_scale is None, "PP serving supports full-precision KV"
     n_stages = mesh.shape[stage_axis]
     m = microbatches or n_stages
     b, s_pad = tokens.shape
@@ -193,85 +254,68 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
     assert cfg.n_layers % n_stages == 0
     stacked = (stacked_layers if stacked_layers is not None
                else stack_llama_stages(params, n_stages))
+    quant = cache.quantized
+    packed = quant and L._kv_packed(cfg, cache)
 
     x = L.gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
     h_dim = x.shape[-1]
     x_mb = x.reshape(m, bm, s_pad, h_dim)
     lengths_mb = lengths.reshape(m, bm)
+    if slots is None:
+        slots = jnp.arange(b, dtype=jnp.int32)
+    slots_mb = slots.reshape(m, bm)
     angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
 
-    def local(stage_layers, k_c, v_c, x_mb, lengths_mb):
+    def local(stage_layers, kv, x_mb, lengths_mb, slots_mb):
         n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
         positions = jnp.broadcast_to(jnp.arange(s_pad)[None, :], (bm, s_pad))
 
-        def stage_apply(h, mb_idx, valid, k_c, v_c):
+        def stage_apply(h, mb_idx, valid, kv):
             seq_lens = lengths_mb[mb_idx]
+            rows = slots_mb[mb_idx]                       # [bm] cache rows
 
             def body(carry, xs):
-                layer, k_li, v_li = xs
+                layer, k_li, v_li = xs[0], xs[1], xs[2]
                 h2, k, v = L._block_prefill(cfg, layer, carry, angles,
                                             positions, seq_lens)
-                # row-granular garbage-tick masking (see decode stage_apply)
-                orig_k = jax.lax.dynamic_slice(
-                    k_li, (mb_idx * bm, 0, 0), (bm, s_pad, cfg.kv_dim))
-                orig_v = jax.lax.dynamic_slice(
-                    v_li, (mb_idx * bm, 0, 0), (bm, s_pad, cfg.kv_dim))
-                k_li = jax.lax.dynamic_update_slice(
-                    k_li, jnp.where(
-                        valid,
-                        k.reshape(bm, s_pad, cfg.kv_dim).astype(k_li.dtype),
-                        orig_k),
-                    (mb_idx * bm, 0, 0))
-                v_li = jax.lax.dynamic_update_slice(
-                    v_li, jnp.where(
-                        valid,
-                        v.reshape(bm, s_pad, cfg.kv_dim).astype(v_li.dtype),
-                        orig_v),
-                    (mb_idx * bm, 0, 0))
-                return h2, (k_li, v_li)
+                k_new = k.reshape(bm, s_pad, cfg.kv_dim)
+                v_new = v.reshape(bm, s_pad, cfg.kv_dim)
+                if quant:
+                    ks_li, vs_li = xs[3], xs[4]
+                    k_new, ks = L._quantize_kv(k_new, packed)
+                    v_new, vs = L._quantize_kv(v_new, packed)
+                    # row-granular garbage-tick masking, scales included
+                    ks_li = ks_li.at[rows, :s_pad].set(
+                        jnp.where(valid, ks, ks_li[rows, :s_pad]))
+                    vs_li = vs_li.at[rows, :s_pad].set(
+                        jnp.where(valid, vs, vs_li[rows, :s_pad]))
+                k_li = k_li.at[rows, :s_pad].set(
+                    jnp.where(valid, k_new.astype(k_li.dtype),
+                              k_li[rows, :s_pad]))
+                v_li = v_li.at[rows, :s_pad].set(
+                    jnp.where(valid, v_new.astype(v_li.dtype),
+                              v_li[rows, :s_pad]))
+                return h2, ((k_li, v_li, ks_li, vs_li) if quant
+                            else (k_li, v_li))
 
-            h, (k_new, v_new) = jax.lax.scan(body, h, (layers, k_c, v_c))
-            return h, k_new, v_new
+            h, kv = jax.lax.scan(body, h, (layers, *kv))
+            return h, kv
 
-        ticks = m + n_st - 1
-        out_buf = jnp.zeros((m, bm, s_pad, h_dim), x_mb.dtype)
-        cur = jnp.zeros((bm, s_pad, h_dim), x_mb.dtype)
+        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
+                           stage_axis)
 
-        def tick(t, carry):
-            cur, out_buf, k_c, v_c = carry
-            mb = jnp.clip(t - my, 0, m - 1)
-            valid = jnp.logical_and(t - my >= 0, t - my < m)
-            feed = x_mb[jnp.minimum(t, m - 1)]
-            h_in = jnp.where(my == 0, feed, cur)
-            h_out, k_c, v_c = stage_apply(h_in, mb, valid, k_c, v_c)
-            mb_done = t - (n_st - 1)
-            write = jnp.logical_and(my == n_st - 1, mb_done >= 0)
-            out_buf = jax.lax.cond(
-                write,
-                lambda buf: jax.lax.dynamic_update_index_in_dim(
-                    buf, h_out, jnp.maximum(mb_done, 0), 0),
-                lambda buf: buf, out_buf)
-            cur = jax.lax.ppermute(h_out, stage_axis, perm)
-            return cur, out_buf, k_c, v_c
-
-        cur, out_buf, k_c, v_c = jax.lax.fori_loop(
-            0, ticks, tick, (cur, out_buf, k_c, v_c))
-        contrib = jnp.where(my == n_st - 1, out_buf, jnp.zeros_like(out_buf))
-        return jax.lax.psum(contrib, stage_axis), k_c, v_c
-
-    out, k_new, v_new = jax.shard_map(
+    out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(stage_axis), kv_cache_stage_specs(),
-                  kv_cache_stage_specs(), P(*(None,) * 4), P(None, None)),
-        out_specs=(P(*(None,) * 4), kv_cache_stage_specs(),
-                   kv_cache_stage_specs()),
+        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+                  P(None, None), P(None, None)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
         check_vma=False,
-    )(stacked, cache.k, cache.v, x_mb, lengths_mb)
+    )(stacked, _kv_tuple(cache), x_mb, lengths_mb, slots_mb)
 
     x_final = out.reshape(b, s_pad, h_dim)
     last = x_final[jnp.arange(b), lengths - 1][:, None]
     logits = L._logits(cfg, params, last)[:, 0]
-    return type(cache)(k_new, v_new), logits
+    return _rebuild(cache, kv_out), logits
 
 
 def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
@@ -282,7 +326,8 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
     tokens [B] current token per slot, lengths [B] cached tokens; the B
     slots split into ``microbatches`` groups that flow through the stages
     GPipe-style (steady-state keeps every stage busy).  Returns (cache',
-    logits [B, V]) matching ``llama.decode_step``.
+    logits [B, V]) matching ``llama.decode_step``, including quantized
+    caches (per-token scales written alongside the int8/int4 rows).
 
     Hot paths MUST hoist ``stack_llama_stages`` once and pass
     ``stacked_layers``: the default restacks every layer's weights (a
@@ -291,7 +336,6 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
     from k8s_llm_rca_tpu.models import llama as L
     from k8s_llm_rca_tpu.ops.attention import decode_attention
 
-    assert cache.k_scale is None, "PP serving supports full-precision KV"
     n_stages = mesh.shape[stage_axis]
     m = microbatches or n_stages
     b = tokens.shape[0]
@@ -301,6 +345,9 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
     stacked = (stacked_layers if stacked_layers is not None
                else stack_llama_stages(params, n_stages))
     s_max = cache.max_seq_len
+    quant = cache.quantized
+    packed = quant and L._kv_packed(cfg, cache)
+    kv_last = cache.k.shape[-1]                  # kv_dim (or kv_dim/2 packed)
 
     x = L.gather_rows(params["embedding"],
                       tokens[:, None]).astype(jnp.dtype(cfg.dtype))  # [B,1,H]
@@ -310,34 +357,46 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
     angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     dtype = jnp.dtype(cfg.dtype)
 
-    def local(stage_layers, k_c, v_c, x_mb, lengths_mb):
+    def local(stage_layers, kv, x_mb, lengths_mb):
         n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
 
-        def stage_apply(h, mb_idx, valid, k_c, v_c):
+        def stage_apply(h, mb_idx, valid, kv):
             lens = lengths_mb[mb_idx]                     # [bm]
             positions = lens[:, None]
 
             def body(carry, xs):
-                layer, k_li, v_li = xs
+                layer, k_li, v_li = xs[0], xs[1], xs[2]
                 # shared decode block halves (models/llama._decode_qkv /
                 # _decode_finish) keep PP token-for-token with decode_step
                 q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
+                k_tok = k[:, 0].reshape(bm, cfg.kv_dim)
+                v_tok = v[:, 0].reshape(bm, cfg.kv_dim)
                 orig_k = jax.lax.dynamic_slice(
-                    k_li, (mb_idx * bm, 0, 0), (bm, s_max, cfg.kv_dim))
+                    k_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
                 orig_v = jax.lax.dynamic_slice(
-                    v_li, (mb_idx * bm, 0, 0), (bm, s_max, cfg.kv_dim))
+                    v_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
+                if quant:
+                    ks_li, vs_li = xs[3], xs[4]
+                    k_tok, ks1 = L._quantize_kv(k_tok, packed)
+                    v_tok, vs1 = L._quantize_kv(v_tok, packed)
+                    orig_ks = jax.lax.dynamic_slice(
+                        ks_li, (mb_idx * bm, 0), (bm, s_max))
+                    orig_vs = jax.lax.dynamic_slice(
+                        vs_li, (mb_idx * bm, 0), (bm, s_max))
+                    ks_rows = L._write_token_scale(orig_ks, ks1, lens)
+                    vs_rows = L._write_token_scale(orig_vs, vs1, lens)
+                else:
+                    ks_rows = vs_rows = None
                 k_rows = L._write_token_kv(
-                    orig_k, k[:, 0].reshape(bm, cfg.kv_dim).astype(
-                        orig_k.dtype), lens)
+                    orig_k, k_tok.astype(orig_k.dtype), lens)
                 v_rows = L._write_token_kv(
-                    orig_v, v[:, 0].reshape(bm, cfg.kv_dim).astype(
-                        orig_v.dtype), lens)
+                    orig_v, v_tok.astype(orig_v.dtype), lens)
                 attn = decode_attention(
                     q,
-                    k_rows.astype(dtype).reshape(bm, s_max, cfg.n_kv_heads,
-                                                 cfg.head_dim),
-                    v_rows.astype(dtype).reshape(bm, s_max, cfg.n_kv_heads,
-                                                 cfg.head_dim),
+                    L._dequant_layer(k_rows, ks_rows, dtype, packed).reshape(
+                        bm, s_max, cfg.n_kv_heads, cfg.head_dim),
+                    L._dequant_layer(v_rows, vs_rows, dtype, packed).reshape(
+                        bm, s_max, cfg.n_kv_heads, cfg.head_dim),
                     lens + 1)
                 hx = L._decode_finish(
                     cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
@@ -349,45 +408,228 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                 v_li = jax.lax.dynamic_update_slice(
                     v_li, jnp.where(valid, v_rows, orig_v),
                     (mb_idx * bm, 0, 0))
+                if quant:
+                    ks_li = jax.lax.dynamic_update_slice(
+                        ks_li, jnp.where(valid, ks_rows, orig_ks),
+                        (mb_idx * bm, 0))
+                    vs_li = jax.lax.dynamic_update_slice(
+                        vs_li, jnp.where(valid, vs_rows, orig_vs),
+                        (mb_idx * bm, 0))
+                    return hx, (k_li, v_li, ks_li, vs_li)
                 return hx, (k_li, v_li)
 
-            h, (k_new, v_new) = jax.lax.scan(body, h, (layers, k_c, v_c))
-            return h, k_new, v_new
+            h, kv = jax.lax.scan(body, h, (layers, *kv))
+            return h, kv
 
-        ticks = m + n_st - 1
-        out_buf = jnp.zeros((m, bm, 1, h_dim), x_mb.dtype)
-        cur = jnp.zeros((bm, 1, h_dim), x_mb.dtype)
+        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
+                           stage_axis)
 
-        def tick(t, carry):
-            cur, out_buf, k_c, v_c = carry
-            mb = jnp.clip(t - my, 0, m - 1)
-            valid = jnp.logical_and(t - my >= 0, t - my < m)
-            feed = x_mb[jnp.minimum(t, m - 1)]
-            h_in = jnp.where(my == 0, feed, cur)
-            h_out, k_c, v_c = stage_apply(h_in, mb, valid, k_c, v_c)
-            mb_done = t - (n_st - 1)
-            write = jnp.logical_and(my == n_st - 1, mb_done >= 0)
-            out_buf = jax.lax.cond(
-                write,
-                lambda buf: jax.lax.dynamic_update_index_in_dim(
-                    buf, h_out, jnp.maximum(mb_done, 0), 0),
-                lambda buf: buf, out_buf)
-            cur = jax.lax.ppermute(h_out, stage_axis, perm)
-            return cur, out_buf, k_c, v_c
-
-        cur, out_buf, k_c, v_c = jax.lax.fori_loop(
-            0, ticks, tick, (cur, out_buf, k_c, v_c))
-        contrib = jnp.where(my == n_st - 1, out_buf, jnp.zeros_like(out_buf))
-        return jax.lax.psum(contrib, stage_axis), k_c, v_c
-
-    out, k_new, v_new = jax.shard_map(
+    out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(stage_axis), kv_cache_stage_specs(),
-                  kv_cache_stage_specs(), P(*(None,) * 4), P(None, None)),
-        out_specs=(P(*(None,) * 4), kv_cache_stage_specs(),
-                   kv_cache_stage_specs()),
+        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+                  P(None, None)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
         check_vma=False,
-    )(stacked, cache.k, cache.v, x_mb, lengths_mb)
+    )(stacked, _kv_tuple(cache), x_mb, lengths_mb)
 
     logits = L._logits(cfg, params, out.reshape(b, 1, h_dim))[:, 0]
-    return type(cache)(k_new, v_new), logits
+    return _rebuild(cache, kv_out), logits
+
+
+# ---------------------------------------------------------------------------
+# paged-pool PP serving
+# ---------------------------------------------------------------------------
+
+
+def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
+                     mesh: Mesh, microbatches: int = None,
+                     stage_axis: str = "stage", stacked_layers=None):
+    """Pipeline-parallel paged prefill: N sequences' KV scattered into
+    their pool pages, the pool's LAYER axis sharded over "stage".
+
+    tokens [N, S_pad] right-padded with S_pad a page multiple; lengths
+    [N]; page_maps [N, S_pad // page_size] page ids (same contract as
+    engine/paged.paged_prefill_batch, incl. idempotent duplicate padding
+    rows).  N must divide into ``microbatches``.  Returns (pool', logits
+    [N, V] at each row's last valid token).  Supports quantized pools.
+    """
+    from k8s_llm_rca_tpu.models import llama as L
+    from k8s_llm_rca_tpu.engine.paged import PagePool, _pool_packed
+
+    n_stages = mesh.shape[stage_axis]
+    m = microbatches or n_stages
+    b, s_pad = tokens.shape
+    assert b % m == 0, (b, m)
+    bm = b // m
+    assert cfg.n_layers % n_stages == 0
+    page_size = pool.page_size
+    assert s_pad % page_size == 0, (s_pad, page_size)
+    n_seq_pages = s_pad // page_size
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+    quant = pool.quantized
+    packed = quant and _pool_packed(cfg, pool)
+
+    x = L.gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
+    h_dim = x.shape[-1]
+    x_mb = x.reshape(m, bm, s_pad, h_dim)
+    lengths_mb = lengths.reshape(m, bm)
+    maps_mb = page_maps.reshape(m, bm, n_seq_pages)
+    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def local(stage_layers, kv, x_mb, lengths_mb, maps_mb):
+        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
+        positions = jnp.broadcast_to(jnp.arange(s_pad)[None, :], (bm, s_pad))
+
+        def stage_apply(h, mb_idx, valid, kv):
+            seq_lens = lengths_mb[mb_idx]
+            pages = maps_mb[mb_idx]               # [bm, n_seq_pages]
+
+            def body(carry, xs):
+                layer, k_li, v_li = xs[0], xs[1], xs[2]
+                h2, k, v = L._block_prefill(cfg, layer, carry, angles,
+                                            positions, seq_lens)
+                k_new = k.reshape(bm, s_pad, cfg.kv_dim)
+                v_new = v.reshape(bm, s_pad, cfg.kv_dim)
+                if quant:
+                    ks_li, vs_li = xs[3], xs[4]
+                    k_new, ks = L._quantize_kv(k_new, packed)
+                    v_new, vs = L._quantize_kv(v_new, packed)
+                    ks = ks.reshape(bm, n_seq_pages, page_size)
+                    vs = vs.reshape(bm, n_seq_pages, page_size)
+                    ks_li = ks_li.at[pages].set(
+                        jnp.where(valid, ks, ks_li[pages]))
+                    vs_li = vs_li.at[pages].set(
+                        jnp.where(valid, vs, vs_li[pages]))
+                k_new = k_new.reshape(bm, n_seq_pages, page_size, -1)
+                v_new = v_new.reshape(bm, n_seq_pages, page_size, -1)
+                k_li = k_li.at[pages].set(
+                    jnp.where(valid, k_new.astype(k_li.dtype), k_li[pages]))
+                v_li = v_li.at[pages].set(
+                    jnp.where(valid, v_new.astype(v_li.dtype), v_li[pages]))
+                return h2, ((k_li, v_li, ks_li, vs_li) if quant
+                            else (k_li, v_li))
+
+            h, kv = jax.lax.scan(body, h, (layers, *kv))
+            return h, kv
+
+        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
+                           stage_axis)
+
+    out, kv_out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+                  P(None, None), P(None, None, None)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
+        check_vma=False,
+    )(stacked, _kv_tuple(pool), x_mb, lengths_mb, maps_mb)
+
+    x_final = out.reshape(b, s_pad, h_dim)
+    last = x_final[jnp.arange(b), lengths - 1][:, None]
+    logits = L._logits(cfg, params, last)[:, 0]
+    return _rebuild(pool, kv_out), logits
+
+
+def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
+                         mesh: Mesh, microbatches: int = None,
+                         stage_axis: str = "stage", stacked_layers=None):
+    """One pipeline-parallel paged decode step for ALL slots.
+
+    tokens [B]; lengths [B]; block_tables [B, pages_per_seq].  The new
+    token's KV scatters into each slot's current page on the LOCAL layer
+    slice; attention reads the gathered dense view (the XLA paged path —
+    pallas_call has no SPMD rule, and per-stage grids are small).  Returns
+    (pool', logits [B, V]) matching ``paged.paged_decode_step``, incl.
+    quantized pools.  Hot paths must pass a hoisted ``stacked_layers``.
+    """
+    from k8s_llm_rca_tpu.models import llama as L
+    from k8s_llm_rca_tpu.engine.paged import _pool_packed
+    from k8s_llm_rca_tpu.ops.attention import decode_attention
+
+    n_stages = mesh.shape[stage_axis]
+    m = microbatches or n_stages
+    b = tokens.shape[0]
+    assert b % m == 0, (b, m)
+    bm = b // m
+    assert cfg.n_layers % n_stages == 0
+    page_size = pool.page_size
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+    quant = pool.quantized
+    packed = quant and _pool_packed(cfg, pool)
+    pages_per_seq = block_tables.shape[1]
+    s_max = pages_per_seq * page_size
+
+    x = L.gather_rows(params["embedding"],
+                      tokens[:, None]).astype(jnp.dtype(cfg.dtype))  # [B,1,H]
+    h_dim = x.shape[-1]
+    x_mb = x.reshape(m, bm, 1, h_dim)
+    lengths_mb = lengths.reshape(m, bm)
+    bt_mb = block_tables.reshape(m, bm, pages_per_seq)
+    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    dtype = jnp.dtype(cfg.dtype)
+
+    def local(stage_layers, kv, x_mb, lengths_mb, bt_mb):
+        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
+
+        def stage_apply(h, mb_idx, valid, kv):
+            lens = lengths_mb[mb_idx]                     # [bm]
+            bt = bt_mb[mb_idx]                            # [bm, pages_per_seq]
+            positions = lens[:, None]
+            page_idx = lens // page_size
+            page_ids = jnp.take_along_axis(
+                bt, page_idx[:, None], axis=1)[:, 0]      # [bm]
+            offsets = lens % page_size                    # [bm]
+
+            def body(carry, xs):
+                layer, k_li, v_li = xs[0], xs[1], xs[2]
+                q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
+                k_tok = k[:, 0].reshape(bm, cfg.kv_dim)
+                v_tok = v[:, 0].reshape(bm, cfg.kv_dim)
+                if quant:
+                    ks_li, vs_li = xs[3], xs[4]
+                    k_tok, ks1 = L._quantize_kv(k_tok, packed)
+                    v_tok, vs1 = L._quantize_kv(v_tok, packed)
+                    ks_li = ks_li.at[page_ids, offsets].set(
+                        jnp.where(valid, ks1, ks_li[page_ids, offsets]))
+                    vs_li = vs_li.at[page_ids, offsets].set(
+                        jnp.where(valid, vs1, vs_li[page_ids, offsets]))
+                k_li = k_li.at[page_ids, offsets].set(
+                    jnp.where(valid, k_tok.astype(k_li.dtype),
+                              k_li[page_ids, offsets]))
+                v_li = v_li.at[page_ids, offsets].set(
+                    jnp.where(valid, v_tok.astype(v_li.dtype),
+                              v_li[page_ids, offsets]))
+                # gathered dense per-sequence view of the LOCAL layer slice
+                k_all = L._dequant_layer(
+                    jnp.take(k_li, bt, axis=0),
+                    jnp.take(ks_li, bt, axis=0) if quant else None,
+                    dtype, packed).reshape(bm, s_max, cfg.n_kv_heads,
+                                           cfg.head_dim)
+                v_all = L._dequant_layer(
+                    jnp.take(v_li, bt, axis=0),
+                    jnp.take(vs_li, bt, axis=0) if quant else None,
+                    dtype, packed).reshape(bm, s_max, cfg.n_kv_heads,
+                                           cfg.head_dim)
+                attn = decode_attention(q, k_all, v_all, lens + 1)
+                hx = L._decode_finish(
+                    cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
+                return hx, ((k_li, v_li, ks_li, vs_li) if quant
+                            else (k_li, v_li))
+
+            h, kv = jax.lax.scan(body, h, (layers, *kv))
+            return h, kv
+
+        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
+                           stage_axis)
+
+    out, kv_out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+                  P(None, None), P(None, None, None)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
+        check_vma=False,
+    )(stacked, _kv_tuple(pool), x_mb, lengths_mb, bt_mb)
+
+    logits = L._logits(cfg, params, out.reshape(b, 1, h_dim))[:, 0]
+    return _rebuild(pool, kv_out), logits

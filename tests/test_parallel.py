@@ -838,3 +838,161 @@ def test_ep_tp_dp_composed_engine_matches_dense(cpu_devices):
     got = eng.generate(prompts, max_new_tokens=6)
     for r, g in zip(ref, got):
         assert r.token_ids == g.token_ids
+
+
+# ---------------------------------------------------------------------------
+# PP ENGINE integration (VERDICT r2 item 1): pp_mesh= on both engines
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "int4"])
+def test_pp_engine_matches_plain(cpu_devices, kv_dtype):
+    """Serving PP: the continuous-batching engine with ``pp_mesh=`` — layer
+    axis of weights AND KV cache sharded over "stage", admissions through
+    the batched pipelined prefill, decode GPipe-microbatched — must emit
+    the plain engine's exact greedy tokens, incl. quantized KV (the
+    optimization that carries the big single-chip configs)."""
+    from k8s_llm_rca_tpu.config import EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=4, max_seq_len=64,
+                        prefill_buckets=(16, 32), max_new_tokens=6,
+                        temperature=0.0, kv_cache_dtype=kv_dtype,
+                        decode_chunk=1)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True),
+               tok.encode("oom killed container", add_bos=True)]
+
+    with jax.default_matmul_precision("float32"):
+        ref = make_engine(cfg, ecfg, params, tok).generate(
+            prompts, max_new_tokens=6)
+        eng = make_engine(cfg, ecfg, params, tok, pp_mesh=mesh)
+        got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids, kv_dtype
+    # the cache is genuinely stage-sharded: 1/P of the layer axis per device
+    shard = eng.cache.k.sharding.shard_shape(eng.cache.k.shape)
+    assert shard[0] == cfg.n_layers // 2
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_pp_paged_engine_matches_plain(cpu_devices, kv_dtype):
+    """Paged PP serving: the page pool's layer axis shards over "stage";
+    pipelined prefill scatters pages per stage and decode reads the
+    gathered local page view — exact greedy parity with the plain paged
+    engine, incl. continuous-batching admission/retirement churn."""
+    from k8s_llm_rca_tpu.config import EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=4, max_seq_len=64,
+                        prefill_buckets=(16, 32), max_new_tokens=6,
+                        temperature=0.0, kv_cache_dtype=kv_dtype,
+                        paged=True, page_size=16, num_pages=32,
+                        prefix_cache=False, decode_chunk=1)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True),
+               tok.encode("oom killed container", add_bos=True),
+               tok.encode("node disk pressure taint", add_bos=True),
+               tok.encode("dns resolution failing", add_bos=True)]
+
+    with jax.default_matmul_precision("float32"):
+        ref = PagedInferenceEngine(cfg, ecfg, params, tok).generate(
+            prompts, max_new_tokens=6)
+        eng = PagedInferenceEngine(cfg, ecfg, params, tok, pp_mesh=mesh)
+        got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids, kv_dtype
+    shard = eng.pool.k.sharding.shard_shape(eng.pool.k.shape)
+    assert shard[0] == cfg.n_layers // 2
+    eng.allocator.check()                      # no pages leaked under PP
+
+
+def test_pp_engine_dfa_scan_parity(cpu_devices):
+    """Grammar-constrained decode stays on the fast path under PP: the
+    DFA rides inside the chunked scan whose body is the PIPELINED decode
+    step, emitting the same tokens as the stepwise host path."""
+    import json as jsonlib
+
+    from k8s_llm_rca_tpu.config import EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.engine.constrain import make_grammar
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=128, n_layers=4)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    schema = {"type": "object", "properties": [
+        ("kind", {"enum": ["Pod", "Service", "Node"]}),
+        ("ok", {"type": "boolean"})]}
+    prompt = tok.encode("diagnose:", add_bos=True)
+
+    outs = {}
+    with jax.default_matmul_precision("float32"):
+        for chunk in (1, 8):
+            ecfg = EngineConfig(max_batch=4, max_seq_len=128,
+                                prefill_buckets=(16, 32), max_new_tokens=40,
+                                decode_chunk=chunk)
+            eng = make_engine(cfg, ecfg, params, tok, pp_mesh=mesh)
+            rid = eng.submit(prompt, max_new_tokens=40,
+                             grammar=make_grammar(schema, tok))
+            res = {r.seq_id: r for r in eng.run_to_completion()}
+            outs[chunk] = res[rid].text
+    assert outs[1] == outs[8], outs
+    jsonlib.loads(outs[1])
+
+
+def test_pp_mesh_validation(cpu_devices):
+    """PP preconditions fail loudly at construction, not mid-serve."""
+    from k8s_llm_rca_tpu.config import EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    pp = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    tp = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    base = dict(max_batch=4, max_seq_len=64, prefill_buckets=(16, 32),
+                max_new_tokens=4)
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_engine(cfg, EngineConfig(**base), params, tok,
+                    pp_mesh=pp, tp_mesh=tp)
+    from jax.sharding import Mesh as _Mesh
+
+    no_stage = _Mesh(np.array(cpu_devices[:2]), ("x",))
+    with pytest.raises(ValueError, match="stage"):
+        make_engine(cfg, EngineConfig(**base), params, tok, pp_mesh=no_stage)
+    with pytest.raises(ValueError, match="n_layers"):
+        make_engine(cfg.replace(n_layers=3), EngineConfig(**base),
+                    llama.init_params(cfg.replace(n_layers=3),
+                                      jax.random.PRNGKey(0)),
+                    tok, pp_mesh=pp)
+    with pytest.raises(ValueError, match="microbatches"):
+        make_engine(cfg, EngineConfig(**base), params, tok, pp_mesh=pp,
+                    pp_microbatches=3)
+    with pytest.raises(ValueError, match="speculative"):
+        make_engine(cfg, EngineConfig(speculative_k=2, **base), params,
+                    tok, pp_mesh=pp)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        PagedInferenceEngine(
+            cfg, EngineConfig(paged=True, page_size=16, num_pages=32,
+                              prefix_cache=True, **base),
+            params, tok, pp_mesh=pp)
+    with pytest.raises(ValueError, match="use_kernel"):
+        PagedInferenceEngine(
+            cfg, EngineConfig(paged=True, page_size=16, num_pages=32,
+                              prefix_cache=False, **base),
+            params, tok, pp_mesh=pp, use_kernel=True)
