@@ -68,20 +68,38 @@ def params_multi_device(params) -> bool:
     return False
 
 
-def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh=None) -> None:
+def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh=None,
+                     cp_seq_axis: str = "seq") -> None:
     """TP cache-sharding preconditions: the merged kv axis splits over
     "model" head-aligned (see runtime.sharding.kv_cache_specs) and the
-    slot batch over "data".  CP+TP in one engine is unsupported — the
-    cache can take only one distributed layout and the CP prefill path is
-    not TP-aware."""
+    slot batch over "data".
+
+    CP composes with TP only on ONE mesh carrying both axes (the cache
+    takes the composed seq-major × head-minor layout and the ring/Ulysses
+    prefill runs per head shard — SURVEY §7 hard part 6); two DIFFERENT
+    mesh objects cannot both own the cache."""
     if tp_mesh is None:
         return
-    if cp_mesh is not None:
-        raise ValueError("cp_mesh and tp_mesh are mutually exclusive")
     for axis in ("data", "model"):
         if axis not in tp_mesh.shape:
             raise ValueError(f"tp_mesh needs a '{axis}' axis, has "
                              f"{dict(tp_mesh.shape)}")
+    if cp_mesh is not None:
+        if cp_mesh is not tp_mesh:
+            raise ValueError(
+                "cp_mesh and tp_mesh must be the SAME composed mesh "
+                "(one Mesh carrying 'data', 'model' and the seq axis); "
+                "two distinct meshes cannot both lay out the cache")
+        if cp_seq_axis not in tp_mesh.shape:
+            raise ValueError(f"composed mesh lacks the '{cp_seq_axis}' axis")
+        n_tp = tp_mesh.shape["model"]
+        if model_cfg.n_heads % n_tp or model_cfg.n_kv_heads % n_tp:
+            # the CP attention shards HEADS over "model" (unexpanded GQA
+            # KV rides the ring), so both head counts must split evenly
+            raise ValueError(
+                f"n_heads={model_cfg.n_heads}/n_kv_heads="
+                f"{model_cfg.n_kv_heads} not divisible by model axis "
+                f"{n_tp} (required for CP×TP prefill)")
     if model_cfg.kv_dim % (2 * tp_mesh.shape["model"]):
         # the factor 2 keeps the nibble-packed int4 layout shardable too
         raise ValueError(
@@ -643,7 +661,8 @@ class InferenceEngine(EngineBase):
                 tuple(engine_cfg.prefill_buckets)
                 + (engine_cfg.max_seq_len,))
         validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
-        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh)
+        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh,
+                         cp_seq_axis)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
                                       cp_mesh, ep_mesh, tp_mesh,
                                       pp_microbatches, pp_stage_axis)
@@ -667,7 +686,24 @@ class InferenceEngine(EngineBase):
             model_cfg, b, engine_cfg.max_seq_len,
             kv_dtype={"int8": jnp.int8, "int4": "int4", None: None}[
                 engine_cfg.kv_cache_dtype])
-        if tp_mesh is not None:
+        if tp_mesh is not None and cp_mesh is not None:
+            # CP×TP composed serving (one mesh, validated above): the
+            # cache takes the seq-major × head-minor layout — S over the
+            # seq axis, the merged kv axis over "model", slots over
+            # "data".  Prefill rides the TP-aware ring/Ulysses below;
+            # decode needs no custom kernel (GSPMD partitions attention
+            # over BOTH axes and inserts the combines)
+            from k8s_llm_rca_tpu.runtime.sharding import (
+                kv_cache_cp_specs, shard_pytree,
+            )
+
+            kv_spec, scale_spec = kv_cache_cp_specs(cp_seq_axis, "model",
+                                                    "data")
+            self.cache = shard_pytree(
+                self.cache,
+                llama.KVCache(kv_spec, kv_spec, scale_spec, scale_spec),
+                tp_mesh)
+        elif tp_mesh is not None:
             # place the cache sharded from the start (merged kv axis over
             # "model", slots over "data") so each device holds 1/P of the
             # KV bytes — the real memory win of serving TP
@@ -753,9 +789,14 @@ class InferenceEngine(EngineBase):
             self._prefill = None        # PP admits through the batched path
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0)
         elif cp_mesh is not None:
+            # composed CP×TP names "model" so the ring/all-to-all runs per
+            # head shard instead of all-gathering TP-sharded heads
+            cp_head_axis = "model" if tp_mesh is not None else None
+
             def _prefill_cp(cfg, params, cache, toks, n, slot):
                 return llama.prefill_cp(cfg, params, cache, toks, n, slot,
-                                        cp_mesh, cp_seq_axis, cp_mode)
+                                        cp_mesh, cp_seq_axis, cp_mode,
+                                        cp_head_axis)
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:

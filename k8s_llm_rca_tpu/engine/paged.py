@@ -325,7 +325,7 @@ def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
 def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
                      tokens: jnp.ndarray, length: jnp.ndarray,
                      page_map: jnp.ndarray, mesh, seq_axis: str = "seq",
-                     cp_mode: str = "ring"):
+                     cp_mode: str = "ring", head_axis: Optional[str] = None):
     """Context-parallel paged prefill: ring/Ulysses attention compute
     (llama.prefill_kv_cp, sequence sharded over ``mesh[seq_axis]``) with
     the page-scatter write — long prompts prefill across the ICI ring
@@ -335,7 +335,8 @@ def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
     page_size = pool.page_size
     assert s_pad % page_size == 0, (s_pad, page_size)
     new_k, new_v, logits = llama.prefill_kv_cp(cfg, params, tokens, length,
-                                               mesh, seq_axis, cp_mode)
+                                               mesh, seq_axis, cp_mode,
+                                               head_axis)
     pool = _write_pool_pages(cfg, pool, new_k, new_v, page_map,
                              s_pad // page_size, page_size)
     return pool, logits
@@ -660,7 +661,8 @@ class PagedInferenceEngine(EngineBase):
             validate_tp_mesh,
         )
         validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
-        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh)
+        validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh,
+                         cp_seq_axis)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
                                       cp_mesh, ep_mesh, tp_mesh,
                                       pp_microbatches, pp_stage_axis)
@@ -814,10 +816,14 @@ class PagedInferenceEngine(EngineBase):
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0,
                                           donate_argnums=donate)
         elif cp_mesh is not None:
+            # composed CP×TP names "model" so the ring/all-to-all runs per
+            # head shard instead of all-gathering TP-sharded heads
+            cp_head_axis = "model" if tp_mesh is not None else None
+
             def _prefill_cp(cfg, params, pool, toks, n, page_map):
                 return paged_prefill_cp(cfg, params, pool, toks, n,
                                         page_map, cp_mesh, cp_seq_axis,
-                                        cp_mode)
+                                        cp_mode, cp_head_axis)
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0,
                                     donate_argnums=donate)

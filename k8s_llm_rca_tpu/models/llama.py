@@ -592,7 +592,7 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
 
 def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
                   length: jnp.ndarray, mesh, seq_axis: str = "seq",
-                  cp_mode: str = "ring"
+                  cp_mode: str = "ring", head_axis: Optional[str] = None
                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Context-parallel prefill: ``prefill_kv`` with the sequence sharded
     over ``mesh[seq_axis]``.
@@ -611,6 +611,11 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
     tokens [1, S_pad] with S_pad divisible by the axis size.  Returns
     (new_k [L, S_pad, n_kv, d], new_v, logits [1, V]).
+
+    ``head_axis``: optional mesh axis sharding attention heads — the
+    CP×TP composition (TP-sharded params produce head-sharded q/k/v;
+    naming the axis keeps the ring/all-to-all per head shard instead of
+    all-gathering heads at the shard_map boundary).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -628,7 +633,8 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     x = jax.lax.with_sharding_constraint(
         x, jax.sharding.NamedSharding(mesh, P(None, seq_axis, None)))
 
-    attn = lambda q, k, v: cp_attn(q, k, v, mesh, seq_axis=seq_axis)
+    attn = lambda q, k, v: cp_attn(q, k, v, mesh, seq_axis=seq_axis,
+                                   head_axis=head_axis)
     ks, vs = [], []
     for layer in params["layers"]:
         x, k, v = _block_prefill(cfg, layer, x, angles, positions,
@@ -643,12 +649,13 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
                tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
-               mesh, seq_axis: str = "seq", cp_mode: str = "ring"
+               mesh, seq_axis: str = "seq", cp_mode: str = "ring",
+               head_axis: Optional[str] = None
                ) -> Tuple[KVCache, jnp.ndarray]:
     """Context-parallel variant of ``prefill``: same cache-write contract,
     ring/Ulysses attention compute (see prefill_kv_cp)."""
     new_k, new_v, logits = prefill_kv_cp(cfg, params, tokens, length, mesh,
-                                         seq_axis, cp_mode)
+                                         seq_axis, cp_mode, head_axis)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 

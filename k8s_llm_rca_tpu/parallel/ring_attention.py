@@ -92,14 +92,29 @@ def _ring_attention_local(q, k, v, axis_name: str):
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   mesh: Mesh, seq_axis: str = "seq") -> jnp.ndarray:
+                   mesh: Mesh, seq_axis: str = "seq",
+                   head_axis: Optional[str] = None) -> jnp.ndarray:
     """Causal self-attention with sequence sharded over ``seq_axis``.
 
     q [B, S, n_heads, d], k/v [B, S, n_kv, d] (global views).  S must be
     divisible by the axis size.  Returns [B, S, n_heads, d].
+
+    ``head_axis``: optional second mesh axis sharding the HEAD dim — the
+    CP×TP composition.  Heads are independent in attention, so the body
+    runs unchanged on its local head block while KV blocks ring over
+    ``seq_axis`` per head-shard; without it, a TP-sharded caller would
+    all-gather heads at the shard_map boundary and duplicate the ring on
+    every model device.  n_heads AND n_kv must divide the axis size (the
+    ring carries unexpanded GQA KV).
     """
+    if head_axis is not None:
+        n_tp = mesh.shape[head_axis]
+        if q.shape[2] % n_tp or k.shape[2] % n_tp:
+            raise ValueError(
+                f"heads {q.shape[2]}/{k.shape[2]} not divisible by "
+                f"{head_axis}={n_tp}")
     body = functools.partial(_ring_attention_local, axis_name=seq_axis)
-    spec = P(None, seq_axis, None, None)
+    spec = P(None, seq_axis, head_axis, None)
     return jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

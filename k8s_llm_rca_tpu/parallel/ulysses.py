@@ -12,6 +12,7 @@ one device's memory.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,16 +52,28 @@ def _ulysses_local(q, k, v, axis_name: str):
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      mesh: Mesh, seq_axis: str = "seq") -> jnp.ndarray:
+                      mesh: Mesh, seq_axis: str = "seq",
+                      head_axis: Optional[str] = None) -> jnp.ndarray:
     """Causal attention with sequence sharded over ``seq_axis`` via
     head<->sequence all-to-all.  n_heads must be divisible by the axis size
-    (GQA kv heads are expanded first)."""
+    (GQA kv heads are expanded first).
+
+    ``head_axis``: optional second mesh axis sharding the HEAD dim (CP×TP
+    composition): each model shard runs the seq<->head all-to-all on its
+    own head block, so the per-device head count (n_heads / tp) must still
+    divide the ``seq_axis`` size."""
     axis = mesh.shape[seq_axis]
-    if q.shape[2] % axis:
+    n_tp = mesh.shape[head_axis] if head_axis is not None else 1
+    if q.shape[2] % n_tp or (head_axis is not None and k.shape[2] % n_tp):
         raise ValueError(
-            f"n_heads {q.shape[2]} not divisible by {seq_axis}={axis}")
+            f"heads {q.shape[2]}/{k.shape[2]} not divisible by "
+            f"{head_axis}={n_tp}")
+    if (q.shape[2] // n_tp) % axis:
+        raise ValueError(
+            f"n_heads {q.shape[2]}/{n_tp} per shard not divisible by "
+            f"{seq_axis}={axis}")
     body = functools.partial(_ulysses_local, axis_name=seq_axis)
-    spec = P(None, seq_axis, None, None)
+    spec = P(None, seq_axis, head_axis, None)
     return jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

@@ -103,14 +103,20 @@ def kv_cache_specs() -> Any:
     return P(None, "data", None, "model")
 
 
-def kv_cache_cp_specs(seq_axis: str = "seq") -> Any:
+def kv_cache_cp_specs(seq_axis: str = "seq", head_axis: str = None,
+                      data_axis: str = None) -> Any:
     """Context-parallel KV cache layout: the SEQUENCE axis of k/v
     [L, B, S, kv] shards over ``seq_axis`` so each device stores 1/P of a
     long context's KV bytes.  Decode under this layout needs no custom
     kernel: GSPMD partitions the attention reduction over S and inserts
     the combine collectives (greedy-parity-tested in test_parallel.py).
-    Returns (kv_spec, scale_spec) — scales [L, B, S] shard likewise."""
-    return (P(None, None, seq_axis, None), P(None, None, seq_axis))
+    Returns (kv_spec, scale_spec) — scales [L, B, S] shard likewise.
+
+    ``head_axis``/``data_axis``: the CP×TP composition — the merged kv
+    axis additionally shards over "model" (seq-major × head-minor) and
+    slots over "data", stacking the TP layout on the CP one."""
+    return (P(None, data_axis, seq_axis, head_axis),
+            P(None, data_axis, seq_axis))
 
 
 def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:

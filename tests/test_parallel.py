@@ -790,19 +790,136 @@ def test_cp_engine_decodes_with_sharded_cache(cpu_devices):
     assert shard[2] == ecfg.max_seq_len // 4
 
 
-def test_cp_and_tp_mesh_mutually_exclusive(cpu_devices):
+def test_cp_tp_requires_one_composed_mesh(cpu_devices):
+    """CP×TP composes only on ONE mesh carrying both axes: two distinct
+    mesh objects (which would each claim the cache layout) are rejected,
+    as is a composed mesh whose head counts don't split over 'model'."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine.engine import InferenceEngine
     from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
 
     cfg = TINY.replace(max_seq_len=64)
-    mesh = build_mesh(MeshConfig(data=1, model=2, seq=2),
-                      devices=cpu_devices[:4])
+    mesh_a = build_mesh(MeshConfig(data=1, model=2, seq=2),
+                        devices=cpu_devices[:4])
+    mesh_b = build_mesh(MeshConfig(data=1, model=2, seq=2),
+                        devices=cpu_devices[4:8])
     ecfg = EngineConfig(max_batch=2, max_seq_len=64, prefill_buckets=(16,))
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        InferenceEngine(cfg, ecfg, llama.init_params(cfg,
-                                                     jax.random.PRNGKey(0)),
-                        get_tokenizer(), cp_mesh=mesh, tp_mesh=mesh)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="SAME composed mesh"):
+        InferenceEngine(cfg, ecfg, params, get_tokenizer(),
+                        cp_mesh=mesh_a, tp_mesh=mesh_b)
+    with pytest.raises(ValueError, match="not divisible by model"):
+        # n_kv_heads=2 cannot split over model=4
+        mesh4 = build_mesh(MeshConfig(data=1, model=4, seq=2),
+                           devices=cpu_devices[:8])
+        InferenceEngine(cfg, ecfg, params, get_tokenizer(),
+                        cp_mesh=mesh4, tp_mesh=mesh4)
+
+
+@pytest.mark.parametrize("cp_mode", ["ring", "ulysses"])
+def test_cp_tp_composed_engine_matches_plain(cpu_devices, cp_mode):
+    """CP×TP in ONE mesh (SURVEY §7 hard part 6 — the long-context 8B
+    shape: TP heads within a node, sequence ring across): the cache takes
+    the seq-major × head-minor layout (S over 'seq', merged kv over
+    'model', slots over 'data'), prefill runs the TP-aware ring/Ulysses
+    per head shard, decode composes via GSPMD — exact greedy parity."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2, seq=2),
+                      devices=cpu_devices[:8])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32), max_new_tokens=6,
+                        decode_chunk=1)
+    prompts = [tok.encode("pod crashloop kube-system", add_bos=True),
+               tok.encode("node disk pressure taint", add_bos=True)]
+
+    with jax.default_matmul_precision("float32"):
+        ref = InferenceEngine(cfg, ecfg, params, tok).generate(
+            prompts, max_new_tokens=6)
+        eng = InferenceEngine(cfg, ecfg, sharded, tok, cp_mesh=mesh,
+                              tp_mesh=mesh, cp_mode=cp_mode)
+        got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids, cp_mode
+    # the cache is genuinely sharded on BOTH axes: seq and merged-kv halved
+    shard = eng.cache.k.sharding.shard_shape(eng.cache.k.shape)
+    assert shard[2] == cfg.max_seq_len // 2        # seq over 'seq'
+    assert shard[3] == cfg.kv_dim // 2             # kv over 'model'
+    assert shard[1] == 1                           # slots over 'data'
+
+
+def test_cp_tp_composed_engine_quantized_cache(cpu_devices):
+    """CP×TP × int8 KV: the composed layout shards the quantized payload
+    and its per-token scales; greedy parity holds."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2, seq=2),
+                      devices=cpu_devices[:8])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32), max_new_tokens=6,
+                        kv_cache_dtype="int8", decode_chunk=1)
+    prompts = [tok.encode("pvc not bound", add_bos=True)]
+
+    with jax.default_matmul_precision("float32"):
+        ref = InferenceEngine(cfg, ecfg, params, tok).generate(
+            prompts, max_new_tokens=6)
+        eng = InferenceEngine(cfg, ecfg, sharded, tok, cp_mesh=mesh,
+                              tp_mesh=mesh)
+        got = eng.generate(prompts, max_new_tokens=6)
+    assert ref[0].token_ids == got[0].token_ids
+
+
+def test_cp_tp_composed_paged_engine_matches_plain(cpu_devices):
+    """Paged CP×TP: TP-aware ring prefill scatters into the model-sharded
+    page pool; decode shards pages over 'model' via GSPMD — exact greedy
+    parity with the plain paged engine."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2, seq=2),
+                      devices=cpu_devices[:8])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32), max_new_tokens=6,
+                        paged=True, page_size=16, num_pages=32,
+                        prefix_cache=False, decode_chunk=1)
+    prompts = [tok.encode("pod crashloop kube-system", add_bos=True),
+               tok.encode("node disk pressure taint", add_bos=True)]
+
+    with jax.default_matmul_precision("float32"):
+        ref = PagedInferenceEngine(cfg, ecfg, params, tok).generate(
+            prompts, max_new_tokens=6)
+        eng = PagedInferenceEngine(cfg, ecfg, sharded, tok, cp_mesh=mesh,
+                                   tp_mesh=mesh)
+        got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+    eng.allocator.check()
 
 
 def test_ep_tp_dp_composed_engine_matches_dense(cpu_devices):
