@@ -467,6 +467,15 @@ class JsonGrammar:
 #    "min_items": a, "max_items": b}       '[' items ', '-separated ']'
 #   {"type": "object", "properties":
 #    [(key, S), ...]}                      fixed keys, fixed order
+#   {"type": "choice", "options":
+#    ["txt1", "txt2", ...]}                 RAW-text alternative (no JSON
+#                                           quoting; options prefix-free) —
+#                                           compiled templates (e.g. the
+#                                           Cypher skeleton grammar) offer
+#                                           the model a bounded choice of
+#                                           complete well-formed variants
+#   {"type": "seq", "items": [S, ...]}     raw concatenation of nodes (no
+#                                           JSON decorations; template glue)
 
 
 def _compile_schema(schema: Dict) -> Tuple:
@@ -512,6 +521,31 @@ def _compile_schema(schema: Dict) -> Tuple:
             nodes.append(_compile_schema(sub))
         nodes.append(("lit", "}" if props else "{}"))
         return ("seq", tuple(nodes))
+    if t == "choice":
+        # dedup by VALUE (duplicates would leave the candidate set unable
+        # to narrow to one, so the frame could never pop)
+        opts = tuple(dict.fromkeys(str(o) for o in schema["options"]))
+        if not opts or any(not o for o in opts):
+            raise ValueError("choice options must be non-empty strings")
+        for a in opts:
+            for b in opts:
+                if a != b and b.startswith(a):
+                    # the candidate-narrowing frame pops only on a UNIQUE
+                    # fully-consumed candidate; prefix pairs would make the
+                    # shorter option unreachable
+                    raise ValueError(
+                        f"choice options must be prefix-free: {a!r} "
+                        f"prefixes {b!r}")
+        if len(opts) == 1:
+            return ("lit", opts[0])
+        # raw-text alternatives reuse the boolean machinery: "bool" is
+        # exactly candidate narrowing over ("true", "false")
+        return ("bool", opts)
+    if t == "seq":
+        items = tuple(_compile_schema(s) for s in schema["items"])
+        if not items:
+            raise ValueError("seq items must be non-empty")
+        return ("seq", items)
     raise ValueError(f"unsupported schema node: {schema!r}")
 
 
@@ -523,8 +557,8 @@ def _node_first_char(node: Tuple) -> str:
         return '"'
     if kind == "int":
         return "0"
-    if kind == "bool":
-        return "t"
+    if kind == "bool":                     # also generic raw-text choices
+        return min(node[1], key=len)[0]
     if kind == "arr":
         return "["
     if kind == "seq":
@@ -801,12 +835,32 @@ class SchemaGrammar:
         return Constraint(force=forced)
 
     def _forced_literal(self) -> Optional[Constraint]:
-        """When the automaton sits in a literal span, force the longest
-        token lying entirely inside the remaining span."""
+        """When the automaton sits in a literal span — or a candidate
+        ("bool"/choice) frame whose remaining candidates all agree on the
+        next characters — force the longest token lying entirely inside
+        the agreed span.  This keeps per-request template grammars (e.g.
+        the stage-2 Cypher skeleton, long literals + one branch point)
+        O(1) per token: the O(V·len) mask build runs only at genuine
+        divergence points."""
         f = self.auto.stack[-1] if self.auto.stack else None
-        if f is None or f[0] != "lit":
+        if f is None:
             return None
-        upcoming = f[1][f[2]:]
+        if f[0] == "lit":
+            upcoming = f[1][f[2]:]
+        elif f[0] == "bool":
+            # common prefix of all remaining candidates' suffixes
+            suffixes = [c[f[2]:] for c in f[1]]
+            upcoming = suffixes[0]
+            for s in suffixes[1:]:
+                n = min(len(upcoming), len(s))
+                i = 0
+                while i < n and upcoming[i] == s[i]:
+                    i += 1
+                upcoming = upcoming[:i]
+            if not upcoming:
+                return None                  # divergence point: mask
+        else:
+            return None
         best = self._char_token.get(upcoming[0])
         best_len = 1 if best is not None else 0
         if len(upcoming) > 1:
@@ -890,6 +944,16 @@ def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
     if name is None:
         return None
     if isinstance(name, dict):
+        if name.get("type") in ("choice", "seq"):
+            # raw-text template grammars (e.g. the per-incident Cypher
+            # skeleton) are typically ONE-SHOT: the DFA compile + its
+            # per-tokenizer cache assume schema reuse across thousands of
+            # runs, so compiling one state per template character per
+            # request would pay seconds + up to 256MB of tables for
+            # nothing.  The interpreted FSM decodes these O(1) per token
+            # (forced spans; the mask build runs only at divergence
+            # points).
+            return SchemaGrammar(name, tokenizer)
         # prefer the compiled DFA (tables cached per tokenizer; enables the
         # engines' on-device constrained scan); fall back to the
         # interpreted FSM when the schema's state space is too large

@@ -111,8 +111,34 @@ def extend_metapath_construct_string(partial_path) -> str:
     return out
 
 
+def cypher_query_schema(metapath_str: str, error_message: str
+                        ) -> Dict[str, Any]:
+    """Skeleton grammar for stage-2 decode (structured outputs).
+
+    The metapath fully determines the query skeleton — the deterministic
+    compiler below proves it.  So rather than hoping the model reproduces
+    the skeleton (and retrying on syntax errors, reference
+    test_all.py:99-122), the skeleton IS the grammar: decode is
+    constrained to the compiled query text, with the model's remaining
+    freedom a bounded CHOICE between complete well-formed variants
+    (numeric aliases n1/n2/... vs kind-derived camelCase aliases, the two
+    styles the few-shot template exhibits).  Cross-referenced aliases
+    cannot be free slots in a stack-automaton grammar (the RETURN clause
+    must repeat the MATCH aliases), which is why freedom lives at the
+    whole-variant level.  Under this grammar ANY model emits a
+    syntactically valid, label-faithful query on the first attempt."""
+    variants = []
+    for style in ("numeric", "kind"):
+        q = compile_metapath_query(metapath_str, error_message,
+                                   alias_style=style, quiet=True)
+        if q not in variants:
+            variants.append(q)
+    return {"type": "choice", "options": variants}
+
+
 def generate_cypher_query(metapath_str: str, error_message: str,
-                          generator: GenericAssistant) -> str:
+                          generator: GenericAssistant,
+                          constrain: bool = True) -> str:
     prompt = f"""\
 Use generation-template-1 to generate a cypher query for the following case.
 Strictly follow the (srcKind)-[rel]->(destKind) ordering, never reverse it.
@@ -123,7 +149,20 @@ the error message to filtering is:
 {error_message}
 """
     generator.add_message(prompt)
-    generator.run_assistant()
+    gen = None
+    if constrain:
+        # per-run override: the skeleton grammar differs per metapath, so
+        # it cannot live on the assistant's GenOptions; budget sized to
+        # the worst-case one-char-per-token decode of the longest variant
+        import dataclasses
+
+        schema = cypher_query_schema(metapath_str, error_message)
+        budget = max(len(o) for o in schema["options"]) + 64
+        gen = dataclasses.replace(
+            generator.assistant.gen, grammar=schema,
+            max_new_tokens=max(generator.assistant.gen.max_new_tokens,
+                               budget))
+    generator.run_assistant(gen=gen)
     messages = generator.wait_get_last_k_message(1)
     if messages is None:
         raise RuntimeError(
@@ -152,10 +191,18 @@ def parse_metapath_string(metapath_str: str) -> List[List[str]]:
     return edges
 
 
-def compile_metapath_query(metapath_str: str, error_message: str) -> str:
+def compile_metapath_query(metapath_str: str, error_message: str,
+                           alias_style: str = "numeric",
+                           quiet: bool = False) -> str:
     """Deterministic metapath -> Cypher compiler.  Unlike the LLM it cannot
     fail; used when generation exhausts its retries or returns zero rows
-    (reference fallback wiring: test_all.py:127-131)."""
+    (reference fallback wiring: test_all.py:127-131), and as the skeleton
+    source for the stage-2 decode grammar (cypher_query_schema).
+
+    ``alias_style``: "numeric" (n1, n2, ...) or "kind" (camelCase of the
+    node kind, as the few-shot template's worked example writes them)."""
+    if alias_style not in ("numeric", "kind"):
+        raise ValueError(f"unknown alias_style {alias_style!r}")
     metapath = parse_metapath_string(metapath_str)
 
     aliases: Dict[str, str] = {"EVENT": "evt"}
@@ -163,7 +210,10 @@ def compile_metapath_query(metapath_str: str, error_message: str) -> str:
     for _, src_kind, dest_kind, _key in metapath:
         for kind in (src_kind, dest_kind):
             if kind not in aliases:
-                aliases[kind] = f"n{idx}"
+                if alias_style == "kind":
+                    aliases[kind] = kind[0].lower() + kind[1:]
+                else:
+                    aliases[kind] = f"n{idx}"
                 idx += 1
 
     parts = [
@@ -185,7 +235,8 @@ def compile_metapath_query(metapath_str: str, error_message: str) -> str:
     interleaved[1::2] = rels
     parts.append("RETURN " + ", ".join(interleaved))
     query = "\n".join(parts)
-    log.info("deterministically compiled cypher query:\n%s", query)
+    if not quiet:
+        log.info("deterministically compiled cypher query:\n%s", query)
     return query
 
 

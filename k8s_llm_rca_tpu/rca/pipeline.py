@@ -100,6 +100,8 @@ class RCAPipeline:
                         analysis: Dict[str, Any]) -> List[Any]:
         """Cypher generation with retries + deterministic fallback
         (test_all.py:99-131).  Mutates ``analysis`` with attempt metadata."""
+        from k8s_llm_rca_tpu.serve.backend import BudgetError
+
         records: List[Any] = []
         cypher_query = None
         generated_ok = False
@@ -111,6 +113,14 @@ class RCAPipeline:
                 records = cyphergen.run_and_filter_query(
                     self.state_executor, cypher_query)
                 generated_ok = True
+                break
+            except BudgetError as e:
+                # the budget cannot hold ANY valid output for this request:
+                # retrying replays the identical failure (and the feedback
+                # message would only grow the prompt further) — go straight
+                # to the deterministic fallback
+                log.warning("cypher budget error (attempt %d): %s",
+                            attempt, e)
                 break
             except CypherSyntaxError as e:
                 log.warning("cypher syntax error (attempt %d): %s", attempt, e)

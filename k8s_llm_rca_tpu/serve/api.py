@@ -330,9 +330,12 @@ class GenericAssistant:
     def add_message(self, content: str) -> None:
         self.message = self.service.add_message(self.thread.id, content)
 
-    def run_assistant(self, instructions: Optional[str] = None) -> None:
+    def run_assistant(self, instructions: Optional[str] = None,
+                      gen: Optional[GenOptions] = None) -> None:
+        """``gen``: per-run GenOptions override (e.g. a request-specific
+        grammar — the cypher skeleton grammar differs per metapath)."""
         self.run = self.service.create_run(
-            self.thread.id, self.assistant.id, instructions)
+            self.thread.id, self.assistant.id, instructions, gen)
 
     def get_run_status(self) -> Run:
         return self.service.retrieve_run(self.run.id)

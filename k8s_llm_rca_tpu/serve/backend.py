@@ -40,6 +40,12 @@ class GenOptions:
     grammar: Optional[object] = None
 
 
+class BudgetError(ValueError):
+    """The effective token budget cannot hold the grammar's minimal
+    document — no valid output exists, so retrying the SAME request is
+    futile by construction (callers should fall back, not retry)."""
+
+
 @dataclass
 class BackendResult:
     text: str
@@ -81,7 +87,7 @@ class EngineBackend:
             _, effective = self.engine._clamp_prompt(ids,
                                                      opts.max_new_tokens)
             if effective < min_budget():
-                raise ValueError(
+                raise BudgetError(
                     f"effective token budget {effective} (requested "
                     f"{opts.max_new_tokens}, clamped by prompt length "
                     f"{len(ids)} vs cache cap "

@@ -558,3 +558,134 @@ class TestCompiledDFA:
         cn, _ = _enumerate_char_dfa(_compile_schema(report_schema()),
                                     alphabet, max_states=10**6)
         assert cn.shape[0] <= _DFA_MAX_TABLE_BYTES // (5 * 32000)
+
+
+# ---------------------------------------------------------------------------
+# raw-text template nodes (choice / seq) — the stage-2 Cypher skeleton
+# grammar (rca/cyphergen.cypher_query_schema) is built from these
+# ---------------------------------------------------------------------------
+
+
+def test_choice_node_accepts_each_option_exactly():
+    from k8s_llm_rca_tpu.engine.constrain import (
+        SchemaAutomaton, _compile_schema,
+    )
+
+    schema = {"type": "choice", "options": ["MATCH (n:Pod)\nRETURN n",
+                                            "MATCH (p:Node)\nRETURN p"]}
+    for opt in schema["options"]:
+        auto = SchemaAutomaton(_compile_schema(schema))
+        for ch in opt:
+            assert auto.accept(ch), (opt, ch)
+        assert auto.complete
+    # diverging from every option is rejected at the divergence point
+    auto = SchemaAutomaton(_compile_schema(schema))
+    for ch in "MATCH (":
+        assert auto.accept(ch)
+    assert not auto.accept("x")
+
+
+def test_choice_node_rejects_prefix_pairs_and_empty():
+    from k8s_llm_rca_tpu.engine.constrain import _compile_schema
+
+    with pytest.raises(ValueError, match="prefix-free"):
+        _compile_schema({"type": "choice", "options": ["ab", "abc"]})
+    with pytest.raises(ValueError, match="non-empty"):
+        _compile_schema({"type": "choice", "options": []})
+    with pytest.raises(ValueError, match="non-empty"):
+        _compile_schema({"type": "choice", "options": ["a", ""]})
+    # a single option degrades to a literal
+    assert _compile_schema({"type": "choice", "options": ["one"]}) == \
+        ("lit", "one")
+
+
+def test_seq_node_concatenates_raw():
+    from k8s_llm_rca_tpu.engine.constrain import (
+        SchemaAutomaton, _compile_schema,
+    )
+
+    schema = {"type": "seq", "items": [
+        {"const": "score="},
+        {"type": "integer", "max_digits": 2},
+        {"const": ";"}]}
+    auto = SchemaAutomaton(_compile_schema(schema))
+    for ch in "score=42;":
+        assert auto.accept(ch), ch
+    assert auto.complete
+
+
+def test_choice_engine_scan_emits_one_option_exactly():
+    """A raw-text choice grammar through the REAL engine (DFA in-scan):
+    random weights must emit one option verbatim, chunked == stepwise."""
+    import jax
+
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import InferenceEngine
+    from k8s_llm_rca_tpu.models import llama
+
+    cfg = TINY
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    schema = {"type": "choice", "options": [
+        "MATCH (evt:EVENT)\nWHERE evt.message CONTAINS 'x'\nRETURN evt",
+        "MATCH (pod:Pod)-[r1:HasEvent]->(evt:EVENT)\nRETURN pod, r1, evt"]}
+    outs = {}
+    for chunk in (1, 8):
+        eng = InferenceEngine(
+            cfg, EngineConfig(max_batch=2, max_seq_len=256,
+                              prefill_buckets=(16,), max_new_tokens=128,
+                              decode_chunk=chunk), params, tok)
+        rid = eng.submit(tok.encode("q:", add_bos=True), max_new_tokens=128,
+                         grammar=make_grammar(schema, tok))
+        res = {r.seq_id: r for r in eng.run_to_completion()}
+        outs[chunk] = res[rid].text
+    assert outs[1] == outs[8]
+    assert outs[1] in schema["options"]
+
+
+def test_cypher_schema_variants_compile_and_run():
+    """cypher_query_schema's options are exactly the deterministic
+    compiler's two alias styles, and BOTH execute against the stategraph
+    (valid mini-Cypher, same records)."""
+    from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+    from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS, build_stategraph
+    from k8s_llm_rca_tpu.rca import cyphergen
+
+    mp = ("\n    HasEvent, Event, EVENT, metadata_uid;\n"
+          "    ReferInternal, Event, Pod, involvedObject_uid;\n"
+          "    ReferInternal, Pod, ConfigMap, spec_volumes_configMap_name;\n")
+    msg = INCIDENTS[0].message
+    schema = cyphergen.cypher_query_schema(mp, msg)
+    assert schema["type"] == "choice" and len(schema["options"]) == 2
+    ex = InMemoryGraphExecutor(build_stategraph())
+    results = [ex.run_query(q) for q in schema["options"]]
+    assert len(results[0]) == len(results[1])
+
+
+def test_choice_dedups_by_value_and_seq_rejects_empty():
+    from k8s_llm_rca_tpu.engine.constrain import _compile_schema
+
+    s = "same option"
+    assert _compile_schema({"type": "choice", "options": [s, s]}) == \
+        ("lit", s)
+    with pytest.raises(ValueError, match="non-empty"):
+        _compile_schema({"type": "seq", "items": []})
+
+
+def test_choice_grammar_skips_dfa_compile():
+    """Template grammars are one-shot (per-request text baked in): they
+    must route to the interpreted FSM, never paying the DFA compile, and
+    force agreed spans in multi-char tokens (O(1) per tick)."""
+    from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
+
+    tok = get_tokenizer()
+    schema = {"type": "choice", "options": ["alpha variant one",
+                                            "beta variant two"]}
+    g = make_grammar(schema, tok)
+    assert isinstance(g, SchemaGrammar)
+    assert not hasattr(tok, "_dfa_tables_cache") or not any(
+        "alpha" in k for k in tok._dfa_tables_cache)
+    # after the first char narrows to one candidate, the span is forced
+    g.advance(tok.encode("a")[0])
+    c = g.constraint(100)
+    assert c.force is not None

@@ -215,7 +215,22 @@ def test_incident_completes_on_engine_backend(paged):
     # ladder ran; whatever it matched carries the full analysis schema
     for analysis in result["analysis"]:
         assert "extend_metapath" in analysis
-        assert "cypher_attempts" in analysis
+        # stage 2 is skeleton-grammar-constrained (cypher_query_schema):
+        # even random weights emit a valid query on the FIRST attempt, so
+        # the reference's retry loop (test_all.py:99-122) is dead code here
+        # like stage 1's.  (The zero-record fallback can still fire for
+        # metapaths that genuinely match nothing — it then compiles the
+        # SAME skeleton, so it must agree with the generated query.)
+        assert analysis["cypher_attempts"] == 1
+        assert analysis["cypher_query"] is not None
+        if "human_cypher_query" in analysis:
+            from k8s_llm_rca_tpu.rca import cyphergen as _cg
+
+            assert analysis["cypher_query"] in (
+                _cg.compile_metapath_query(
+                    analysis["extend_metapath"], result["error_message"],
+                    alias_style=s, quiet=True)
+                for s in ("numeric", "kind"))
         for audited in analysis["statepath"]:
             # the reporter's schema grammar guarantees the report parses in
             # the reference shape even from random weights
@@ -256,3 +271,43 @@ def test_auditor_rejects_label_injection():
     native, external = find_native_external_kinds(meta)
     for kind in native + external:
         assert "MATCH" in find_strict_states(kind, "x", "t")
+
+
+def test_cypher_budget_error_skips_retries_to_fallback():
+    """A BudgetError (grammar's minimal document exceeds the effective
+    budget) is futile to retry — compile_and_run must go STRAIGHT to the
+    deterministic fallback on attempt 1 instead of burning the retry
+    budget on identical failures."""
+    from k8s_llm_rca_tpu.rca import cyphergen
+    from k8s_llm_rca_tpu.serve.backend import BudgetError
+
+    class BudgetBackend:
+        def start(self, prompt, opts):
+            raise BudgetError("budget 4 cannot hold the minimal document")
+
+        def pump(self):
+            return {}
+
+        def busy(self, handle):
+            return False
+
+        def cancel(self, handle):
+            pass
+
+        def count_tokens(self, text):
+            return len(text.split())
+
+    pipeline = RCAPipeline.__new__(RCAPipeline)
+    pipeline.cfg = RCAConfig()
+    pipeline.state_executor = InMemoryGraphExecutor(build_stategraph())
+    service = AssistantService(BudgetBackend())
+    gen = cyphergen.setup_cypher_generator(service)
+    pipeline.cypher_generator = gen
+
+    mp = ("\n    HasEvent, Event, EVENT, metadata_uid;\n"
+          "    ReferInternal, Event, Pod, involvedObject_uid;\n")
+    analysis = {}
+    records = pipeline.compile_and_run(mp, INCIDENTS[0].message, analysis)
+    assert analysis["cypher_attempts"] == 1          # no futile retries
+    assert "human_cypher_query" in analysis          # fallback fired
+    assert isinstance(records, list)
