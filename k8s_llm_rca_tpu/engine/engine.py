@@ -574,13 +574,37 @@ class EngineBase:
             tokens_in[slot, 1:1 + len(d)] = d
         return tokens_in, drafts
 
+    def _uniform_dfa_tables(self):
+        """The single DFA table set shared by ALL grammar slots, or None
+        (no grammar slots, an interpreted FSM, or mixed tables).  When
+        non-None, grammar work can run fully on device — the scan tick
+        and the speculative verify both key off this."""
+        tables = None
+        for st in self._active.values():
+            if st.grammar is None:
+                continue
+            t = getattr(st.grammar, "tables", None)
+            if t is None:
+                return None
+            if tables is None:
+                tables = t
+            elif t is not tables:
+                return None
+        return tables
+
     def _verify_and_commit(self, active_slots, drafts, greedy_host,
-                           logits_host, post_commit=None
+                           logits_host, post_commit=None,
+                           constrained: bool = False
                            ) -> List[SequenceResult]:
         """Shared draft verification: commit the longest prefix of each
         slot's draft that agrees with the model's own greedy (grammar-
         constrained) choice, plus one bonus token from the first
-        disagreeing position.  Greedy-exact by construction."""
+        disagreeing position.  Greedy-exact by construction.
+
+        ``constrained``: the greedy choices were already grammar-
+        constrained ON DEVICE (dfa_greedy_multi) — skip the host-side
+        re-application (the FSM still advances per commit, which also
+        validates the device transition)."""
         finished: List[SequenceResult] = []
         for slot in active_slots:
             st = self._active[slot]
@@ -589,9 +613,13 @@ class EngineBase:
             committed = 0
             reason = None
             for j in range(len(draft) + 1):
-                token = self._greedy_with_grammar(
-                    st, int(greedy_host[slot, j]),
-                    logits_host[slot, j] if logits_host is not None else None)
+                if constrained:
+                    token = int(greedy_host[slot, j])
+                else:
+                    token = self._greedy_with_grammar(
+                        st, int(greedy_host[slot, j]),
+                        logits_host[slot, j]
+                        if logits_host is not None else None)
                 st.generated.append(token)
                 if st.grammar is not None:
                     st.grammar.advance(token)
@@ -618,6 +646,27 @@ class EngineBase:
         # needs a masked argmax (32000x smaller transfer otherwise)
         return any(self._active[s].grammar is not None
                    for s in active_slots)
+
+    def _spec_constrained_greedy(self, greedy, logits, active_slots):
+        """Shared verify-tick grammar handling: when every grammar slot
+        shares one compiled DFA, re-derive the greedy choices CONSTRAINED
+        on device (dfa_greedy_multi — spec×grammar keeps multi-token
+        verify with no [B, T, V] transfer); otherwise fall back to the
+        host path (ship logits, _greedy_with_grammar per position).
+        Returns (greedy_host [B, T], logits_host or None, constrained)."""
+        if not self._need_spec_logits(active_slots):
+            return np.asarray(greedy), None, False
+        tables = self._uniform_dfa_tables()
+        if tables is None:
+            return np.asarray(greedy), np.asarray(logits), False
+        (allow_t, next_t, dist_t, close_t, complete_t,
+         _) = self._dfa_device_tables(tables)
+        states, remaining = self._dfa_scan_vectors(tables)
+        greedy = self._spec_dfa_greedy(
+            logits, jnp.asarray(states), jnp.asarray(remaining),
+            self.tokenizer.eos_id, allow_t, next_t, dist_t, close_t,
+            complete_t)
+        return np.asarray(greedy), None, True
 
 
 class InferenceEngine(EngineBase):
@@ -825,6 +874,7 @@ class InferenceEngine(EngineBase):
             return cache, jnp.argmax(logits, axis=-1), logits
 
         self._decode_multi = jax.jit(_verify_step, static_argnums=0)
+        self._spec_dfa_greedy = jax.jit(dfa_greedy_multi, static_argnums=3)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
         self._decode_scan = jax.jit(
@@ -1076,7 +1126,10 @@ class InferenceEngine(EngineBase):
 
     def _speculative_tick(self) -> List[SequenceResult]:
         """One verification tick on the contiguous cache: score all draft
-        positions in one decode_multi, commit via _verify_and_commit."""
+        positions in one decode_multi, commit via _verify_and_commit.
+        When every grammar slot shares one compiled DFA, the constrained
+        greedy is computed ON DEVICE (dfa_greedy_multi) — spec×grammar
+        keeps multi-token verify with no [B, T, V] logits transfer."""
         active_slots = list(self._active)
         cur_host = np.asarray(self.cur_tokens)
         tokens_in, drafts = self._build_drafts(active_slots, cur_host)
@@ -1085,9 +1138,8 @@ class InferenceEngine(EngineBase):
             self.cache, greedy, logits = self._decode_multi(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(tokens_in), self.lengths)
-            greedy_host = np.asarray(greedy)                      # [B, T]
-        logits_host = (np.asarray(logits)
-                       if self._need_spec_logits(active_slots) else None)
+            greedy_host, logits_host, constrained = \
+                self._spec_constrained_greedy(greedy, logits, active_slots)
 
         lengths_host = np.asarray(self.lengths).copy()
         next_cur = cur_host.copy()
@@ -1097,7 +1149,8 @@ class InferenceEngine(EngineBase):
             next_cur[slot] = token
 
         finished = self._verify_and_commit(active_slots, drafts, greedy_host,
-                                           logits_host, post_commit)
+                                           logits_host, post_commit,
+                                           constrained)
         self.lengths = jnp.asarray(lengths_host)
         self.cur_tokens = jnp.asarray(next_cur)
         return finished
@@ -1175,6 +1228,37 @@ def dfa_scan_step(logits, cur, lens, done, states, remaining, key,
     states = jnp.where(step_dfa, next_t[states, nxt], states)
     remaining = remaining - advance.astype(jnp.int32)
     return cur, lens, newly_done, states, remaining, key
+
+
+def dfa_greedy_multi(logits, states, remaining, eos_id: int,
+                     allow_t, next_t, dist_t, close_t, complete_t):
+    """Grammar-constrained greedy over a verification step's positions,
+    entirely on device (the speculative analog of ``dfa_scan_step``).
+
+    logits [B, T, V]; states/remaining [B] (FREE row for ungrammared
+    slots, whose result is then the plain argmax).  The DFA advances along
+    the CONSTRAINED choices: on the accepted draft prefix they equal the
+    draft (that is what acceptance means), and positions after the first
+    disagreement are never committed by the host.  Returns tokens [B, T],
+    so speculative decoding keeps multi-token verify under a grammar
+    without shipping [B, T, V] logits to the host."""
+
+    def step(carry, lt):
+        states, remaining = carry
+        nxt_states = next_t[states]                       # [B, V]
+        fits = dist_t[nxt_states] <= (remaining - 2)[:, None]
+        rows = allow_t[states] & fits
+        masked = jnp.where(rows, lt, -jnp.inf)
+        tok = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+        tok = jnp.where(rows.any(axis=-1), tok, close_t[states])
+        tok = jnp.where(complete_t[states], eos_id, tok)
+        states = jnp.where(tok != eos_id, next_t[states, tok], states)
+        remaining = remaining - 1
+        return (states, remaining), tok
+
+    _, toks = jax.lax.scan(step, (states, remaining),
+                           jnp.swapaxes(logits, 0, 1))
+    return jnp.swapaxes(toks, 0, 1)                       # [B, T]
 
 
 def decode_scan_dfa(

@@ -861,6 +861,8 @@ class PagedInferenceEngine(EngineBase):
         self._decode_multi = jax.jit(
             functools.partial(paged_decode_multi, ep_mesh=ep_mesh),
             static_argnums=0, donate_argnums=donate)
+        from k8s_llm_rca_tpu.engine.engine import dfa_greedy_multi
+        self._spec_dfa_greedy = jax.jit(dfa_greedy_multi, static_argnums=3)
         self._sample = jax.jit(sample_tokens, static_argnums=2)
         self._sample_masked = jax.jit(sample_tokens_masked, static_argnums=2)
 
@@ -976,23 +978,25 @@ class PagedInferenceEngine(EngineBase):
 
     def _speculative_tick(self, active_slots) -> List[SequenceResult]:
         """Paged verification tick: drafts scored by one paged_decode_multi,
-        committed via the shared _verify_and_commit loop."""
+        committed via the shared _verify_and_commit loop.  Grammar slots
+        sharing one compiled DFA verify constrained ON DEVICE
+        (engine.dfa_greedy_multi) — no [B, T, V] logits transfer."""
         tokens_in, drafts = self._build_drafts(active_slots, self.cur_tokens)
         with METRICS.timer("engine.decode_step"):
             self.pool, greedy, logits = self._decode_multi(
                 self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens_in), jnp.asarray(self.lengths, jnp.int32),
                 jnp.asarray(self.block_tables))
-            greedy_host = np.asarray(greedy)
-        logits_host = (np.asarray(logits)
-                       if self._need_spec_logits(active_slots) else None)
+            greedy_host, logits_host, constrained = \
+                self._spec_constrained_greedy(greedy, logits, active_slots)
 
         def post_commit(slot: int, token: int) -> None:
             self.lengths[slot] += 1
             self.cur_tokens[slot] = token
 
         return self._verify_and_commit(active_slots, drafts, greedy_host,
-                                       logits_host, post_commit)
+                                       logits_host, post_commit,
+                                       constrained)
 
     # ------------------------------------------------- chunked scan tick
 

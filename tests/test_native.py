@@ -177,14 +177,21 @@ class TestNativeGrammar:
             outs[name] = res.token_ids
         assert outs["py"] == outs["cc"]
 
-    def test_engine_config_native_flag_selects_backend(self):
+    def test_engine_config_native_flag_selects_backend(self, monkeypatch):
+        from k8s_llm_rca_tpu.engine import constrain
         from k8s_llm_rca_tpu.engine.constrain import make_grammar
         from k8s_llm_rca_tpu.engine.paged import make_allocator
 
         tok = get_tokenizer()
-        assert isinstance(make_grammar("json", tok),
+        # grammar="json" now compiles the BOUNDED-depth DFA first (it rides
+        # the on-device scan); the native/python unbounded grammars are the
+        # fallback when the tables don't fit
+        assert isinstance(make_grammar("json", tok), constrain.DFAGrammar)
+        monkeypatch.setattr(constrain, "_DFA_MAX_TABLE_BYTES", 1024)
+        tok2 = get_tokenizer()            # fresh: no cached tables
+        assert isinstance(make_grammar("json", tok2),
                           native.NativeJsonGrammar)
-        assert isinstance(make_grammar("json", tok, prefer_native=False),
+        assert isinstance(make_grammar("json", tok2, prefer_native=False),
                           JsonGrammar)
         assert isinstance(make_allocator(8), native.NativePageAllocator)
         assert isinstance(make_allocator(8, prefer_native=False),

@@ -5,6 +5,7 @@ greedy output, only how many tokens a tick commits)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from k8s_llm_rca_tpu.config import TINY, EngineConfig
 from k8s_llm_rca_tpu.engine.engine import InferenceEngine
@@ -217,3 +218,82 @@ def test_feature_matrix_greedy_equivalence():
                 for prefix in (False, True):
                     assert run(spec_k, chunk, prefix, kv) == baseline, (
                         kv, spec_k, chunk, prefix)
+
+
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("grammar_name", ["schema", "json"])
+def test_speculative_dfa_greedy_exactness(paged, grammar_name):
+    """spec × DFA (VERDICT r2 item 6): with every grammar slot on one
+    compiled DFA, drafted tokens verify through the DFA ON DEVICE
+    (engine.dfa_greedy_multi) — multi-token verify is kept and the output
+    must equal the non-speculative greedy run token-for-token."""
+    import json as jsonlib
+
+    import jax
+
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import InferenceEngine
+    from k8s_llm_rca_tpu.engine.constrain import make_grammar
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.utils import get_tokenizer
+
+    cfg = TINY
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    gname = ({"type": "object", "properties": [
+        ("kind", {"enum": ["Pod", "Service", "Node"]}),
+        ("ok", {"type": "boolean"})]} if grammar_name == "schema"
+        else "json")
+    prompt = tok.encode("diagnose: pod crashloop backoff", add_bos=True)
+
+    def run(spec_k):
+        kw = dict(paged=True, page_size=16, num_pages=64,
+                  prefix_cache=False) if paged else {}
+        cls = PagedInferenceEngine if paged else InferenceEngine
+        extra = dict(use_kernel=False) if paged else {}
+        eng = cls(cfg, EngineConfig(max_batch=2, max_seq_len=256,
+                                    prefill_buckets=(16, 32),
+                                    max_new_tokens=48,
+                                    speculative_k=spec_k, decode_chunk=1,
+                                    **kw), params, tok, **extra)
+        rid = eng.submit(prompt, max_new_tokens=48,
+                         grammar=make_grammar(gname, tok))
+        res = {r.seq_id: r for r in eng.run_to_completion()}
+        return res[rid].text
+
+    base, spec = run(0), run(3)
+    assert base == spec
+    jsonlib.loads(base)
+
+
+def test_speculative_interpreted_grammar_host_fallback_exactness():
+    """An INTERPRETED grammar (no compiled tables — here a raw-text choice
+    template) cannot verify on device: the verify tick must take the host
+    path (ship logits, per-position _greedy_with_grammar) and still equal
+    the non-speculative run exactly."""
+    from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar, make_grammar
+
+    cfg = TINY
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    schema = {"type": "choice", "options": [
+        "verdict: pod failed due to missing secret",
+        "checked: node pressure taint evicted the pod"]}
+    prompt = tok.encode("diagnose:", add_bos=True)
+
+    def run(spec_k):
+        eng = InferenceEngine(
+            cfg, EngineConfig(max_batch=2, max_seq_len=256,
+                              prefill_buckets=(16,), max_new_tokens=64,
+                              speculative_k=spec_k, decode_chunk=1),
+            params, tok)
+        g = make_grammar(schema, tok)
+        assert isinstance(g, SchemaGrammar)       # interpreted, no tables
+        rid = eng.submit(prompt, max_new_tokens=64, grammar=g)
+        res = {r.seq_id: r for r in eng.run_to_completion()}
+        return res[rid].text
+
+    base, spec = run(0), run(3)
+    assert base == spec
+    assert base in schema["options"]
