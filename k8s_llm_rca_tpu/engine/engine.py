@@ -59,6 +59,22 @@ def flash_prefill_safe(params) -> bool:
     return True
 
 
+def flash_prefill_plan(params, tp_mesh, model_cfg) -> Tuple[bool, object]:
+    """(use_flash, flash_mesh) for the prefill jits: the plain Pallas
+    kernel when params are unsharded on TPU (flash_prefill_safe), the
+    PER-SHARD kernel (ops.flash_attention_sharded under ``tp_mesh``) when
+    TP-sharded with head counts divisible by the model axis — sharded
+    prefill no longer concedes the kernel to XLA.  (False, None)
+    otherwise (CPU, EP token sharding, indivisible heads)."""
+    if flash_prefill_safe(params):
+        return True, None
+    if (tp_mesh is not None and jax.default_backend() == "tpu"
+            and model_cfg.n_heads % tp_mesh.shape["model"] == 0
+            and model_cfg.n_kv_heads % tp_mesh.shape["model"] == 0):
+        return True, tp_mesh
+    return False, None
+
+
 def params_multi_device(params) -> bool:
     """True when any param leaf carries a >1-device sharding (TP/EP)."""
     for leaf in jax.tree.leaves(params):
@@ -849,14 +865,15 @@ class InferenceEngine(EngineBase):
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:
-            use_flash = flash_prefill_safe(params)
+            use_flash, flash_mesh = flash_prefill_plan(params, tp_mesh,
+                                                       model_cfg)
             self._prefill = jax.jit(
                 functools.partial(llama.prefill, use_flash=use_flash,
-                                  ep_mesh=ep_mesh),
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
                 static_argnums=0)
             self._prefill_batch = jax.jit(
                 functools.partial(llama.prefill_batch, use_flash=use_flash,
-                                  ep_mesh=ep_mesh),
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
                 static_argnums=0)
         # batched admission needs the plain prefill path (prefill_cp is
         # per-sequence)

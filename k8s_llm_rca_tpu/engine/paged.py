@@ -36,7 +36,7 @@ import numpy as np
 
 from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
 from k8s_llm_rca_tpu.engine.engine import (
-    EngineBase, SequenceResult, _Active, _Pending, flash_prefill_safe,
+    EngineBase, SequenceResult, _Active, _Pending, flash_prefill_plan,
     validate_cp_divisibility,
 )
 from k8s_llm_rca_tpu.engine.sampling import (
@@ -254,7 +254,7 @@ def _write_pool_pages(cfg: ModelConfig, pool: PagePool, new_k, new_v,
 def paged_prefill(cfg: ModelConfig, params, pool: PagePool,
                   tokens: jnp.ndarray, length: jnp.ndarray,
                   page_map: jnp.ndarray, use_flash: bool = False,
-                  ep_mesh=None):
+                  ep_mesh=None, flash_mesh=None):
     """Prefill ONE sequence, scattering its KV into ``page_map`` pages.
 
     tokens [1, S_pad] with S_pad a multiple of page_size; page_map
@@ -266,7 +266,7 @@ def paged_prefill(cfg: ModelConfig, params, pool: PagePool,
     page_size = pool.page_size
     assert s_pad % page_size == 0, (s_pad, page_size)
     new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length,
-                                            use_flash, ep_mesh)
+                                            use_flash, ep_mesh, flash_mesh)
     pool = _write_pool_pages(cfg, pool, new_k, new_v, page_map,
                              s_pad // page_size, page_size)
     return pool, logits
@@ -297,7 +297,7 @@ def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
 def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, lengths: jnp.ndarray,
                         page_maps: jnp.ndarray, use_flash: bool = False,
-                        ep_mesh=None):
+                        ep_mesh=None, flash_mesh=None):
     """Prefill N sequences into their pool pages in ONE dispatch.
 
     tokens [N, S_pad] right-padded (S_pad a page multiple); lengths [N];
@@ -312,7 +312,7 @@ def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
     n_seq_pages = s_pad // page_size
     new_k, new_v, logits = llama._prefill_batch_kv(cfg, params, tokens,
                                                    lengths, use_flash,
-                                                   ep_mesh)
+                                                   ep_mesh, flash_mesh)
     # fold the batch dim into the page dim: the single-sequence write
     # helper scatters [L, total_pages, page, kv] by a flat page map
     pool = _write_pool_pages(
@@ -828,16 +828,18 @@ class PagedInferenceEngine(EngineBase):
             self._prefill = jax.jit(_prefill_cp, static_argnums=0,
                                     donate_argnums=donate)
         else:
+            use_flash, flash_mesh = flash_prefill_plan(params, tp_mesh,
+                                                       model_cfg)
             self._prefill = jax.jit(
-                functools.partial(paged_prefill,
-                                  use_flash=flash_prefill_safe(params),
-                                  ep_mesh=ep_mesh),
+                functools.partial(paged_prefill, use_flash=use_flash,
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
                 static_argnums=0, donate_argnums=donate)
         if pp_mesh is None:
+            use_flash, flash_mesh = flash_prefill_plan(
+                params, None if cp_mesh is not None else tp_mesh, model_cfg)
             self._prefill_batch = jax.jit(
-                functools.partial(paged_prefill_batch,
-                                  use_flash=flash_prefill_safe(params),
-                                  ep_mesh=ep_mesh),
+                functools.partial(paged_prefill_batch, use_flash=use_flash,
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
                 static_argnums=0, donate_argnums=donate)
         self._prefill_chunk = jax.jit(
             functools.partial(paged_prefill_chunk, ep_mesh=ep_mesh),

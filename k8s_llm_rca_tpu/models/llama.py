@@ -375,9 +375,26 @@ def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     return _logits(cfg, params, x)
 
 
+def _flash_attention_fn(seq_lens, flash_mesh):
+    """attention_fn for the Pallas flash kernel: per-shard under a TP mesh
+    (ops.flash_attention_sharded — heads sharded over "model"), plain
+    kernel otherwise."""
+    if flash_mesh is not None:
+        from k8s_llm_rca_tpu.ops.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        return lambda q, k, v: flash_attention_sharded(
+            q, k, v, seq_lens, flash_mesh, interpret=None)
+    from k8s_llm_rca_tpu.ops.flash_attention import flash_attention
+
+    return lambda q, k, v: flash_attention(q, k, v, seq_lens,
+                                           interpret=False)
+
+
 def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
                length: jnp.ndarray, use_flash: bool = False,
-               ep_mesh=None
+               ep_mesh=None, flash_mesh=None
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared prefill compute for both cache designs (contiguous slot write
     below, page scatter in engine/paged.py): run the stack over ONE
@@ -402,10 +419,7 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
     attention_fn = None
     if use_flash and s_pad >= 1024:
-        from k8s_llm_rca_tpu.ops.flash_attention import flash_attention
-
-        attention_fn = lambda q, k, v: flash_attention(q, k, v, seq_lens,
-                                                       interpret=False)
+        attention_fn = _flash_attention_fn(seq_lens, flash_mesh)
 
     ks, vs = [], []
     for layer in params["layers"]:
@@ -421,17 +435,18 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
             tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
-            use_flash: bool = False, ep_mesh=None
+            use_flash: bool = False, ep_mesh=None, flash_mesh=None
             ) -> Tuple[KVCache, jnp.ndarray]:
     """Prefill ONE sequence into cache slot ``slot``.
 
     tokens [1, S_pad] right-padded; ``length`` scalar valid length; returns
     (cache', last-token logits [1, V]).  One compile per padded bucket length
     (engine/engine.py buckets prompt lengths to keep recompiles bounded).
-    ``use_flash``: see prefill_kv.
+    ``use_flash``: see prefill_kv.  ``flash_mesh``: run the kernel
+    per-head-shard under this TP mesh (ops.flash_attention_sharded).
     """
     new_k, new_v, logits = prefill_kv(cfg, params, tokens, length, use_flash,
-                                      ep_mesh)
+                                      ep_mesh, flash_mesh)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 
@@ -661,7 +676,7 @@ def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
 
 def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
                       lengths: jnp.ndarray, use_flash: bool = False,
-                      ep_mesh=None
+                      ep_mesh=None, flash_mesh=None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched prefill forward WITHOUT a cache write: tokens [N, S_pad]
     right-padded, lengths [N] -> (new_k [L, N, S_pad, kv_dim], new_v,
@@ -674,10 +689,7 @@ def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
     attention_fn = None
     if use_flash and s_pad >= 1024:
-        from k8s_llm_rca_tpu.ops.flash_attention import flash_attention
-
-        attention_fn = lambda q, k, v: flash_attention(q, k, v, lengths,
-                                                       interpret=False)
+        attention_fn = _flash_attention_fn(lengths, flash_mesh)
 
     ks, vs = [], []
     for layer in params["layers"]:
@@ -694,7 +706,8 @@ def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
                   tokens: jnp.ndarray, lengths: jnp.ndarray,
-                  slots: jnp.ndarray, use_flash: bool = False, ep_mesh=None
+                  slots: jnp.ndarray, use_flash: bool = False, ep_mesh=None,
+                  flash_mesh=None
                   ) -> Tuple[KVCache, jnp.ndarray]:
     """Prefill N sequences into their cache slots in ONE dispatch.
 
@@ -707,7 +720,7 @@ def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
     """
     _, s_pad = tokens.shape
     new_k, new_v, logits = _prefill_batch_kv(cfg, params, tokens, lengths,
-                                             use_flash, ep_mesh)
+                                             use_flash, ep_mesh, flash_mesh)
     if cache.quantized:
         packed = _kv_packed(cfg, cache)
         new_k, k_s = _quantize_kv(new_k, packed)     # scales [L, N, S_pad]

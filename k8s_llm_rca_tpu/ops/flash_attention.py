@@ -189,3 +189,45 @@ def flash_attention(
         qp, kp, vp,
     )
     return out[:, :, :s_q].transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(
+    q: jnp.ndarray,          # [B, S_q, n_heads, d]
+    k: jnp.ndarray,          # [B, S_k, n_kv, d]
+    v: jnp.ndarray,          # [B, S_k, n_kv, d]
+    seq_lens: jnp.ndarray,   # [B]
+    mesh,
+    q_offset: jnp.ndarray | None = None,
+    head_axis: str = "model",
+    **kw,
+) -> jnp.ndarray:
+    """``flash_attention`` under tensor parallelism.
+
+    ``pallas_call`` has no SPMD partitioning rule, so calling the kernel
+    on TP-sharded activations would silently replicate full attention on
+    every device (the reason engine.flash_prefill_safe conceded sharded
+    prefill to XLA).  The fix is the standard shard_map pattern: heads are
+    independent in attention, so each device runs the kernel on ITS head
+    block — q/k/v enter head-sharded over ``head_axis`` (their natural
+    layout under column-parallel wq/wk/wv, so no resharding happens at
+    the boundary) and GQA grouping is preserved per shard.  Both head
+    counts must divide the axis; batch stays unsharded (admission groups
+    are small and need no data split).
+    """
+    n_tp = mesh.shape[head_axis]
+    if q.shape[2] % n_tp or k.shape[2] % n_tp:
+        raise ValueError(
+            f"heads {q.shape[2]}/{k.shape[2]} not divisible by "
+            f"{head_axis}={n_tp}")
+    if q_offset is None:
+        q_offset = jnp.zeros((q.shape[0],), jnp.int32)
+
+    def local(q, k, v, lens, off):
+        return flash_attention(q, k, v, lens, off, **kw)
+
+    spec = jax.sharding.PartitionSpec(None, None, head_axis, None)
+    vec = jax.sharding.PartitionSpec(None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec, vec, vec),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, seq_lens, q_offset)

@@ -188,3 +188,100 @@ class TestPagedAttentionQuant:
             np.testing.assert_allclose(np.asarray(lg_kernel),
                                        np.asarray(lg_xla),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestFlashSharded:
+    """flash under TP (VERDICT r2 item 7): the kernel runs PER HEAD SHARD
+    inside shard_map instead of conceding sharded prefill to XLA."""
+
+    def _mesh(self, cpu_devices):
+        from k8s_llm_rca_tpu.config import MeshConfig
+        from k8s_llm_rca_tpu.runtime.mesh import build_mesh
+
+        return build_mesh(MeshConfig(data=2, model=2),
+                          devices=cpu_devices[:4])
+
+    def test_matches_xla_reference(self, cpu_devices):
+        from k8s_llm_rca_tpu.ops.attention import causal_attention
+        from k8s_llm_rca_tpu.ops.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        mesh = self._mesh(cpu_devices)
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (2, 1024, 4, 16), jnp.float32)
+        k = jax.random.normal(kk, (2, 1024, 2, 16), jnp.float32)
+        v = jax.random.normal(kv, (2, 1024, 2, 16), jnp.float32)
+        lens = jnp.asarray([1024, 700], jnp.int32)
+        with jax.default_matmul_precision("float32"):
+            ref = causal_attention(q, k, v, lens)
+            out = flash_attention_sharded(q, k, v, lens, mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_rejects_indivisible_heads(self, cpu_devices):
+        from k8s_llm_rca_tpu.ops.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        mesh = self._mesh(cpu_devices)
+        q = jnp.zeros((1, 16, 3, 8), jnp.float32)     # 3 heads, model=2
+        kv = jnp.zeros((1, 16, 3, 8), jnp.float32)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention_sharded(q, kv, kv, jnp.asarray([16]), mesh)
+
+    def test_tp_prefill_runs_the_sharded_kernel(self, cpu_devices):
+        """llama.prefill with flash_mesh= on TP-sharded params (the path
+        flash_prefill_plan selects on TPU) matches the plain XLA prefill
+        token-for-token."""
+        from k8s_llm_rca_tpu.config import TINY
+        from k8s_llm_rca_tpu.models import llama
+        from k8s_llm_rca_tpu.runtime.sharding import (
+            llama_param_specs, shard_pytree,
+        )
+
+        mesh = self._mesh(cpu_devices)
+        cfg = TINY.replace(max_seq_len=1024)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 1024), 0,
+                                    cfg.vocab_size)
+        n = jnp.int32(900)
+        with jax.default_matmul_precision("float32"):
+            ref_cache = llama.init_cache(cfg, 2, 1024)
+            ref_cache, ref_lg = llama.prefill(cfg, params, ref_cache,
+                                              tokens, n, jnp.int32(0))
+            fl_cache = llama.init_cache(cfg, 2, 1024)
+            fl_cache, fl_lg = llama.prefill(cfg, sharded, fl_cache, tokens,
+                                            n, jnp.int32(0), use_flash=True,
+                                            flash_mesh=mesh)
+        assert int(jnp.argmax(ref_lg)) == int(jnp.argmax(fl_lg))
+        np.testing.assert_allclose(np.asarray(fl_cache.k[:, 0, :900]),
+                                   np.asarray(ref_cache.k[:, 0, :900]),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_flash_prefill_plan_gating(self, cpu_devices, monkeypatch):
+        from k8s_llm_rca_tpu.config import TINY
+        from k8s_llm_rca_tpu.engine import engine as eng_mod
+        from k8s_llm_rca_tpu.models import llama
+        from k8s_llm_rca_tpu.runtime.sharding import (
+            llama_param_specs, shard_pytree,
+        )
+
+        mesh = self._mesh(cpu_devices)
+        cfg = TINY
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+        # CPU: no kernel anywhere
+        assert eng_mod.flash_prefill_plan(params, None, cfg) == (False, None)
+        assert eng_mod.flash_prefill_plan(sharded, mesh, cfg) == (False,
+                                                                  None)
+        # "TPU": plain kernel unsharded, per-shard kernel under TP
+        monkeypatch.setattr(eng_mod.jax, "default_backend", lambda: "tpu")
+        assert eng_mod.flash_prefill_plan(params, None, cfg) == (True, None)
+        assert eng_mod.flash_prefill_plan(sharded, mesh, cfg) == (True,
+                                                                  mesh)
+        # indivisible heads: concede to XLA
+        cfg3 = cfg.replace(n_heads=6, n_kv_heads=3)
+        assert eng_mod.flash_prefill_plan(sharded, mesh, cfg3) == (False,
+                                                                   None)
