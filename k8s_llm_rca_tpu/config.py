@@ -230,6 +230,11 @@ class RCAConfig:
     run_timeout_s: float = 600.0
     model: str = "tiny"                # serve-side model name
     rerank_top_k: int = 0              # cap audited records when reranking (0 = all)
+    # cap the STATE fields entering each audit prompt to the k most
+    # relevant by embedding (0 = all 12 reference fields); requires a
+    # pipeline reranker — the rerank result then shapes prompt CONTENT,
+    # not just record order (BASELINE configs[4])
+    rerank_fields_top_k: int = 0
 
 
 @dataclass(frozen=True)

@@ -59,15 +59,20 @@ def flash_prefill_safe(params) -> bool:
     return True
 
 
-def flash_prefill_plan(params, tp_mesh, model_cfg) -> Tuple[bool, object]:
+def flash_prefill_plan(params, tp_mesh, model_cfg,
+                       ep_mesh=None) -> Tuple[bool, object]:
     """(use_flash, flash_mesh) for the prefill jits: the plain Pallas
     kernel when params are unsharded on TPU (flash_prefill_safe), the
     PER-SHARD kernel (ops.flash_attention_sharded under ``tp_mesh``) when
     TP-sharded with head counts divisible by the model axis — sharded
     prefill no longer concedes the kernel to XLA.  (False, None)
-    otherwise (CPU, EP token sharding, indivisible heads)."""
+    otherwise (CPU, indivisible heads, or EP: MoE prefill shards TOKENS
+    over data×expert, a layout the head-sharded shard_map wrapper would
+    replicate every layer)."""
     if flash_prefill_safe(params):
         return True, None
+    if ep_mesh is not None:
+        return False, None
     if (tp_mesh is not None and jax.default_backend() == "tpu"
             and model_cfg.n_heads % tp_mesh.shape["model"] == 0
             and model_cfg.n_kv_heads % tp_mesh.shape["model"] == 0):
@@ -866,7 +871,7 @@ class InferenceEngine(EngineBase):
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:
             use_flash, flash_mesh = flash_prefill_plan(params, tp_mesh,
-                                                       model_cfg)
+                                                       model_cfg, ep_mesh)
             self._prefill = jax.jit(
                 functools.partial(llama.prefill, use_flash=use_flash,
                                   ep_mesh=ep_mesh, flash_mesh=flash_mesh),

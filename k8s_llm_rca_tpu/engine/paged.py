@@ -829,14 +829,18 @@ class PagedInferenceEngine(EngineBase):
                                     donate_argnums=donate)
         else:
             use_flash, flash_mesh = flash_prefill_plan(params, tp_mesh,
-                                                       model_cfg)
+                                                       model_cfg, ep_mesh)
             self._prefill = jax.jit(
                 functools.partial(paged_prefill, use_flash=use_flash,
                                   ep_mesh=ep_mesh, flash_mesh=flash_mesh),
                 static_argnums=0, donate_argnums=donate)
         if pp_mesh is None:
-            use_flash, flash_mesh = flash_prefill_plan(
-                params, None if cp_mesh is not None else tp_mesh, model_cfg)
+            if cp_mesh is not None:
+                # batched admission is disabled under CP; keep the plain
+                # plan (no TP-aware kernel) for the never-called jit
+                use_flash, flash_mesh = flash_prefill_plan(params, None,
+                                                           model_cfg,
+                                                           ep_mesh)
             self._prefill_batch = jax.jit(
                 functools.partial(paged_prefill_batch, use_flash=use_flash,
                                   ep_mesh=ep_mesh, flash_mesh=flash_mesh),

@@ -173,9 +173,31 @@ IMPORTANT_FIELDS = ("status", "spec", "path", "server", "subsets", "roleRef",
                     "metadata")
 
 
-def _semantic_prompt(state_node, error_message: str) -> str:
-    projection = {k: state_node[k] for k in IMPORTANT_FIELDS
-                  if state_node[k] is not None}
+def _project_fields(state_node, error_message: str, reranker=None,
+                    fields_top_k: int = 0) -> List[str]:
+    """The STATE fields entering the audit prompt.
+
+    Default: every present IMPORTANT_FIELD (the reference's 12-field
+    projection, analyze_root_cause.py:225-230).  With a reranker and a
+    positive ``fields_top_k``, each candidate field embeds as a
+    "key: value" passage against the error message and only the top-k
+    most relevant fields survive — the rerank result now SHAPES what the
+    auditor reads (BASELINE configs[4] "fused into the RCA prompt loop"),
+    instead of only ordering records."""
+    fields = [k for k in IMPORTANT_FIELDS if state_node[k] is not None]
+    if reranker is None or fields_top_k <= 0 or len(fields) <= fields_top_k:
+        return fields
+    passages = [f"{k}: {state_node[k]}" for k in fields]
+    ranked = reranker.rerank(error_message, passages, fields_top_k)
+    keep = {fields[i] for i, _ in ranked}
+    return [k for k in fields if k in keep]     # stable field order
+
+
+def _semantic_prompt(state_node, error_message: str,
+                     fields: List[str] = None) -> str:
+    if fields is None:
+        fields = _project_fields(state_node, error_message)
+    projection = {k: state_node[k] for k in fields}
     kind = state_node["kind"]
     return f"""\
 The following JSON comes from a {kind} object.  Focus on the 'spec' and
@@ -190,10 +212,14 @@ The JSON is:
 
 
 def check_semantic(state_node, error_message: str,
-                   analyzer: GenericAssistant) -> str:
+                   analyzer: GenericAssistant, reranker=None,
+                   fields_top_k: int = 0) -> str:
     """One semantic LLM round-trip for one STATE node, prompt projected onto
-    the important fields to keep the context small."""
-    analyzer.add_message(_semantic_prompt(state_node, error_message))
+    the important fields to keep the context small (rerank-compressed when
+    a reranker is fused in — see _project_fields)."""
+    fields = _project_fields(state_node, error_message, reranker,
+                             fields_top_k)
+    analyzer.add_message(_semantic_prompt(state_node, error_message, fields))
     analyzer.run_assistant()
     messages = analyzer.wait_get_last_k_message(1)
     if messages is None:
@@ -203,7 +229,8 @@ def check_semantic(state_node, error_message: str,
 
 
 def submit_semantic(state_node, error_message: str,
-                    analyzer: GenericAssistant):
+                    analyzer: GenericAssistant, reranker=None,
+                    fields_top_k: int = 0):
     """Non-blocking variant: START the audit run on its OWN sub-thread.
     The per-entity audits on a statepath are independent until the summary
     barrier (SURVEY §3.4 — the reference serializes one blocking round-trip
@@ -221,7 +248,10 @@ def submit_semantic(state_node, error_message: str,
     sub = service.create_thread()
     service.add_message(sub.id, STATE_RULE)
     service.add_message(sub.id, TASK_PROTOCOL)
-    service.add_message(sub.id, _semantic_prompt(state_node, error_message))
+    fields = _project_fields(state_node, error_message, reranker,
+                             fields_top_k)
+    service.add_message(sub.id, _semantic_prompt(state_node, error_message,
+                                                 fields))
     return service.create_run(sub.id, analyzer.assistant.id)
 
 
@@ -251,7 +281,8 @@ def _missing_state_clue(entity_kind: str, entity_id: str,
 def check_states_of_entity(entity_kind: str, entity_id: str,
                            error_message: str, timestamp: str,
                            query_executor,
-                           analyzer: GenericAssistant) -> List[str]:
+                           analyzer: GenericAssistant, reranker=None,
+                           fields_top_k: int = 0) -> List[str]:
     """Audit one entity: missing STATE -> fabricated apparent-error clue
     pushed into the analyzer thread; present STATEs -> one semantic
     round-trip each."""
@@ -265,7 +296,8 @@ def check_states_of_entity(entity_kind: str, entity_id: str,
     else:
         for record in records:
             state_node = record["n2"]
-            semantic = check_semantic(state_node, error_message, analyzer)
+            semantic = check_semantic(state_node, error_message, analyzer,
+                                      reranker, fields_top_k)
             clues.append(f"{state_node['kind'].upper()}({state_node['id']}): "
                          f"{semantic}")
     for clue in clues:
@@ -328,7 +360,8 @@ def _cancel_fanout_runs(analyzer: GenericAssistant, fanout) -> None:
 
 
 def check_statepath(query_executor, analyzer: GenericAssistant,
-                    statepath, concurrent: bool = True
+                    statepath, concurrent: bool = True, reranker=None,
+                    fields_top_k: int = 0
                     ) -> Tuple[str, Dict[str, List[str]]]:
     """Audit every entity on a matched statepath record, then one summary
     run producing the scored report.  Returns (report_text, path_clues).
@@ -365,7 +398,7 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
         if not concurrent:
             path_clues[label] = check_states_of_entity(
                 entity_kind, entity_id, error_message, timestamp,
-                query_executor, analyzer)
+                query_executor, analyzer, reranker, fields_top_k)
             continue
         # fan-out: missing-STATE clues are synthesized inline; present
         # STATEs get their runs submitted (on sub-threads) without waiting.
@@ -386,7 +419,7 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
                 fanout.append((label, items))
                 for record in records:
                     run = submit_semantic(record["n2"], error_message,
-                                          analyzer)
+                                          analyzer, reranker, fields_top_k)
                     items.append(("run", record["n2"], run))
         except Exception:
             _cancel_fanout_runs(analyzer, fanout)

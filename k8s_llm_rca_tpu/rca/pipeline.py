@@ -186,7 +186,9 @@ class RCAPipeline:
                 for record in records:
                     report, clues = auditor.check_statepath(
                         self.state_executor, self.analyzer, record,
-                        concurrent=self.cfg.concurrent_audits)
+                        concurrent=self.cfg.concurrent_audits,
+                        reranker=self.reranker,
+                        fields_top_k=self.cfg.rerank_fields_top_k)
                     analysis["statepath"].append(
                         {"report": report, "clue": clues})
                 result["analysis"].append(analysis)

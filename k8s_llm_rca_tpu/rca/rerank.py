@@ -92,12 +92,26 @@ class Reranker:
 
     def __init__(self, embedder: Optional[Embedder] = None):
         self.embedder = embedder or Embedder()
+        # per-query embedding cache: one incident reranks records once and
+        # then field-projects EVERY audited STATE node against the SAME
+        # error message — without the cache each audit would re-pay the
+        # query's tokenize + encoder forward (FIFO-bounded)
+        self._query_cache: dict = {}
+
+    def _query_vec(self, query: str) -> np.ndarray:
+        qv = self._query_cache.get(query)
+        if qv is None:
+            qv = self.embedder.encode([QUERY_PREFIX + query])[0]
+            while len(self._query_cache) >= 16:
+                self._query_cache.pop(next(iter(self._query_cache)))
+            self._query_cache[query] = qv
+        return qv
 
     def rerank(self, query: str, passages: Sequence[str],
                top_k: Optional[int] = None) -> List[Tuple[int, float]]:
         if not passages:
             return []
-        qv = self.embedder.encode([QUERY_PREFIX + query])[0]
+        qv = self._query_vec(query)
         pv = self.embedder.encode([PASSAGE_PREFIX + p for p in passages])
         ranked = cosine_rerank(qv, pv)
         return ranked[:top_k] if top_k else ranked

@@ -285,3 +285,6 @@ class TestFlashSharded:
         cfg3 = cfg.replace(n_heads=6, n_kv_heads=3)
         assert eng_mod.flash_prefill_plan(sharded, mesh, cfg3) == (False,
                                                                    None)
+        # EP token sharding: concede to XLA even with a TP mesh present
+        assert eng_mod.flash_prefill_plan(sharded, mesh, cfg,
+                                          ep_mesh=mesh) == (False, None)
