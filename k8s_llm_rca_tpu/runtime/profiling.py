@@ -32,6 +32,26 @@ _PEAK_TFLOPS = {
     "TPU v6 lite": 918.0,     # v6e / Trillium
 }
 
+# HBM bandwidth GB/s per chip (same keys as _PEAK_TFLOPS); the roofline's
+# memory leg.  Decode at small context is bandwidth-bound, so this — not
+# the FLOP peak — is the ceiling a decode tok/s claim must clear.
+_HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5": 2765.0,
+    "TPU v6 lite": 1640.0,
+}
+
+
+def _longest_prefix(table: Dict[str, float], kind: str) -> Optional[float]:
+    """Longest-prefix device-kind lookup ("TPU v5" also prefixes
+    "TPU v5 lite", so longest wins)."""
+    best = None
+    for name, val in table.items():
+        if kind.startswith(name) and (best is None or len(name) > best[0]):
+            best = (len(name), val)
+    return best[1] if best else None
+
 
 @contextlib.contextmanager
 def trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
@@ -75,22 +95,36 @@ def device_memory_stats(device: Optional[Any] = None) -> Dict[str, float]:
 # ---------------------------------------------------------------------------
 
 
-def decoder_param_count(cfg: ModelConfig) -> int:
-    """Parameter count of the Llama/Mixtral stack (embeddings included)."""
+def _layer_matmul_weights(cfg: ModelConfig, routed_only: bool) -> float:
+    """Matmul weight count of ONE decoder layer (attn + MLP + router).
+
+    ``routed_only``: for MoE, count only the top-k routed experts' MLPs —
+    the per-token active set (FLOPs / best-case bytes) — instead of all
+    experts (parameter count).  The single source for the per-layer
+    architecture arithmetic shared by the param/FLOP/bytes models below.
+    """
     h, q, kv, inter = (cfg.hidden_size, cfg.q_dim, cfg.kv_dim,
                        cfg.intermediate_size)
-    per_layer = h * q + 2 * h * kv + q * h + 2 * h        # attn + norms
+    w = h * q + 2 * h * kv + q * h                         # qkv + out proj
     if cfg.n_experts > 0:
-        per_layer += h * cfg.n_experts                     # router
-        per_layer += cfg.n_experts * 3 * h * inter         # expert MLPs
+        w += h * cfg.n_experts                             # router
+        n_mlp = cfg.n_experts_per_tok if routed_only else cfg.n_experts
+        w += n_mlp * 3 * h * inter                         # expert MLPs
     else:
-        per_layer += 3 * h * inter
+        w += 3 * h * inter
+    return float(w)
+
+
+def decoder_param_count(cfg: ModelConfig) -> int:
+    """Parameter count of the Llama/Mixtral stack (embeddings included)."""
+    h = cfg.hidden_size
+    per_layer = _layer_matmul_weights(cfg, routed_only=False) + 2 * h  # norms
     total = cfg.n_layers * per_layer
     total += cfg.vocab_size * h                            # embedding
     total += h                                             # final norm
     if not cfg.tie_embeddings:
         total += cfg.vocab_size * h                        # lm_head
-    return total
+    return int(total)
 
 
 def decode_flops_per_token(cfg: ModelConfig, context_len: int) -> float:
@@ -100,18 +134,11 @@ def decode_flops_per_token(cfg: ModelConfig, context_len: int) -> float:
     routed experts' MLPs count (hard dispatch); attention adds the
     O(context) KV dot products.
     """
-    h, q, kv, inter = (cfg.hidden_size, cfg.q_dim, cfg.kv_dim,
-                       cfg.intermediate_size)
-    per_layer = 2.0 * (h * q + 2 * h * kv + q * h)         # qkv + out proj
-    if cfg.n_experts > 0:
-        per_layer += 2.0 * h * cfg.n_experts               # router
-        per_layer += cfg.n_experts_per_tok * 2.0 * 3 * h * inter
-    else:
-        per_layer += 2.0 * 3 * h * inter
+    per_layer = 2.0 * _layer_matmul_weights(cfg, routed_only=True)
     # attention scores + weighted values: q·K^T and P·V over the context
     per_layer += 2.0 * 2 * cfg.n_heads * cfg.head_dim * context_len
     total = cfg.n_layers * per_layer
-    total += 2.0 * h * cfg.vocab_size                      # logits matmul
+    total += 2.0 * cfg.hidden_size * cfg.vocab_size        # logits matmul
     return total
 
 
@@ -120,18 +147,61 @@ def mfu(cfg: ModelConfig, tokens_per_sec: float, context_len: int,
     """Model FLOPs utilization in [0, 1] against the chip's bf16 peak;
     None when the device kind has no table entry (e.g. CPU)."""
     dev = device or jax.devices()[0]
-    kind = getattr(dev, "device_kind", "")
-    peak = None
-    for name, tf in _PEAK_TFLOPS.items():
-        if kind.startswith(name):
-            # exact-prefix pitfall: "TPU v5" also prefixes "TPU v5 lite";
-            # prefer the longest matching name
-            if peak is None or len(name) > peak[0]:
-                peak = (len(name), tf)
+    peak = _longest_prefix(_PEAK_TFLOPS, getattr(dev, "device_kind", ""))
     if peak is None:
         return None
     flops = decode_flops_per_token(cfg, context_len) * tokens_per_sec
-    return flops / (peak[1] * 1e12)
+    return flops / (peak * 1e12)
+
+
+def decode_bytes_per_token(cfg: ModelConfig, context_len: int, batch: int,
+                           weight_bits: int = 16, kv_bits: int = 16) -> float:
+    """Minimum HBM bytes moved to decode ONE token at a given context.
+
+    Decode traffic per step: every live weight byte is read once (shared
+    across the batch — that sharing is the entire continuous-batching
+    win), and each sequence reads its own KV history and writes one new
+    KV entry.  Quantized tensors carry per-channel/per-token scales;
+    those are second-order (<1%) and folded into a 1% overhead factor
+    rather than modeled exactly.  Activations are negligible at batch
+    decode sizes.  For MoE, only the top-k routed experts' weights are
+    read per token in the best case (each token needs its experts; at
+    large batch every expert is resident but the per-token read cost is
+    still the routed fraction when experts fit in VMEM-sized tiles —
+    we model the optimistic bound, which keeps the roofline an upper
+    bound on achievable tok/s).
+    """
+    wbytes = weight_bits / 8.0
+    per_layer = _layer_matmul_weights(cfg, routed_only=True)
+    # the logits matmul streams one vocab*h table whether or not the
+    # embedding is tied; the input-embedding gather reads one row per
+    # sequence (negligible), not the table
+    weight_per_token = (cfg.n_layers * per_layer
+                        + cfg.vocab_size * cfg.hidden_size) * wbytes / batch
+    kv_per_token = (cfg.n_layers * 2 * cfg.kv_dim
+                    * (context_len + 1) * kv_bits / 8.0)
+    return 1.01 * (weight_per_token + kv_per_token)
+
+
+def roofline_decode_tps(cfg: ModelConfig, context_len: int, batch: int,
+                        weight_bits: int = 16, kv_bits: int = 16,
+                        device: Optional[Any] = None) -> Optional[float]:
+    """Hardware ceiling on whole-chip decode tokens/sec: the min of the
+    compute roofline (bf16 peak / FLOPs-per-token) and the memory
+    roofline (HBM bandwidth / bytes-per-token).  A measured number above
+    this is *physically impossible* — the measurement, not the machine,
+    is broken (e.g. the axon tunnel memoizing identical executions), and
+    the roofline becomes the defensible claim.  None off-TPU."""
+    dev = device or jax.devices()[0]
+    kind = getattr(dev, "device_kind", "")
+    peak_tf = _longest_prefix(_PEAK_TFLOPS, kind)
+    bw = _longest_prefix(_HBM_GBPS, kind)
+    if peak_tf is None or bw is None:
+        return None
+    compute = peak_tf * 1e12 / decode_flops_per_token(cfg, context_len)
+    memory = bw * 1e9 / decode_bytes_per_token(cfg, context_len, batch,
+                                               weight_bits, kv_bits)
+    return min(compute, memory)
 
 
 @dataclass

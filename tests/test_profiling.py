@@ -39,6 +39,59 @@ class TestFlopsModel:
         assert profiling.mfu(TINY, 1000.0, 128) is None  # tests run on CPU
 
 
+class _FakeV5e:
+    device_kind = "TPU v5 lite"
+
+
+class _FakeV5p:
+    device_kind = "TPU v5"
+
+
+class TestRoofline:
+    def test_none_on_cpu(self):
+        assert profiling.roofline_decode_tps(TINY, 128, 8) is None
+
+    def test_prefix_disambiguation(self):
+        # "TPU v5" must not pick up the v5e ("TPU v5 lite") row or
+        # vice versa: v5p has both higher peak and higher bandwidth,
+        # so its roofline strictly dominates at identical config
+        a = profiling.roofline_decode_tps(TINY, 128, 8, device=_FakeV5e())
+        b = profiling.roofline_decode_tps(TINY, 128, 8, device=_FakeV5p())
+        assert a is not None and b is not None and b > a
+
+    def test_memory_bound_at_small_batch(self):
+        # batch 1 streams ~the full weights per token (layer matmuls plus
+        # ONE vocab table — the untied input embedding is a gather, not a
+        # stream, so bytes land slightly under 2*param_count bf16 bytes)
+        bpt = profiling.decode_bytes_per_token(LLAMA3_8B, 128, 1, 16, 16)
+        full = profiling.decoder_param_count(LLAMA3_8B) * 2
+        assert 0.8 * full < bpt < 1.02 * full
+
+    def test_batch_amortizes_weight_traffic(self):
+        b1 = profiling.decode_bytes_per_token(TINY, 128, 1, 16, 16)
+        b64 = profiling.decode_bytes_per_token(TINY, 128, 64, 16, 16)
+        assert b64 < b1 / 8            # weights dominate at short context
+
+    def test_quantization_raises_roofline(self):
+        bf16 = profiling.roofline_decode_tps(TINY, 896, 512, 16, 16,
+                                             device=_FakeV5e())
+        int4 = profiling.roofline_decode_tps(TINY, 896, 512, 4, 4,
+                                             device=_FakeV5e())
+        # int4 shrinks bytes; at batch 512 the compute leg caps both, so
+        # int4 is >= bf16 but cannot exceed the compute ceiling
+        compute = 197e12 / profiling.decode_flops_per_token(TINY, 896)
+        assert bf16 <= int4 <= compute * 1.001
+
+    def test_bench_config_roofline_is_finite_and_physical(self):
+        # the r2 bench wall-clock (208k tok/s TinyLlama int4) must cap
+        from k8s_llm_rca_tpu.config import MODEL_REGISTRY
+
+        cfg = MODEL_REGISTRY["tinyllama-1.1b"]
+        roof = profiling.roofline_decode_tps(cfg, 896, 512, 4, 4,
+                                             device=_FakeV5e())
+        assert 10_000 < roof < 208_000, roof
+
+
 class TestStepTimer:
     def test_tokens_per_sec_and_report(self):
         t = profiling.StepTimer()
