@@ -193,15 +193,21 @@ def bench_rca_p50(n_incidents: int = 100):
     return costs[len(costs) // 2]
 
 
-def bench_rca_p50_engine(n_incidents: int = 3):
-    """End-to-end RCA p50 with every LLM call decoded by the REAL engine on
-    the local accelerator (random weights: the JSON schema grammar keeps
-    stage 1 structurally valid and stage 2 falls back to the deterministic
-    compiler by design, so latency is representative while content is
-    garbage).  Through the axon tunnel each decode tick pays ~0.2-0.3 s of
-    dispatch latency, so only a few incidents with tight budgets are
-    affordable; the tick count per incident matches the real workload
-    shape (one forced-skeleton stage-1 run + capped stage-2/3 runs)."""
+def bench_rca_p50_engine(n_incidents: int = 100, workers: int = 8):
+    """End-to-end RCA p50 over a REAL 100-incident sweep with every LLM
+    call decoded by the engine on the local accelerator (random weights:
+    the stage-1/2 DFA grammars keep outputs structurally valid, so
+    latency is representative while content is garbage).  This is the
+    BASELINE configs[2] measurement: ``workers`` threads drive their own
+    pipelines against ONE shared service/engine, so concurrent incidents'
+    runs merge into shared continuous-batching decode ticks — through the
+    axon tunnel each tick pays ~0.2-0.3 s of dispatch latency, and tick
+    sharing divides that cost across in-flight incidents.  Per-incident
+    ``time_cost`` includes waits for shared ticks: that IS serving
+    latency under continuous batching, not an artifact."""
+    import queue
+    import threading
+
     import jax as _jax
 
     from k8s_llm_rca_tpu.engine import make_engine
@@ -216,23 +222,52 @@ def bench_rca_p50_engine(n_incidents: int = 3):
     params = llama.init_params(cfg, _jax.random.PRNGKey(0))
     tok = get_tokenizer(vocab_size=cfg.vocab_size)
     engine = make_engine(
-        cfg, EngineConfig(max_batch=4, max_seq_len=4096,
+        cfg, EngineConfig(max_batch=8, max_seq_len=4096,
                           prefill_buckets=(1024, 2048, 4096),
                           max_new_tokens=64, temperature=0.0,
-                          # stages 2/3 carry no grammar, so their decode
-                          # amortizes 8 steps per dispatch — the tunnel's
-                          # ~0.25 s tick cost is the whole p50 story here
+                          # unconstrained stages amortize 8 decode steps
+                          # per dispatch; DFA stages ride the same scan
                           decode_chunk=8),
         params, tok)
-    pipeline = RCAPipeline(
-        AssistantService(EngineBackend(engine)),
-        InMemoryGraphExecutor(build_metagraph()),
-        InMemoryGraphExecutor(build_stategraph()),
-        RCAConfig(cypher_max_new_tokens=64, analyzer_max_new_tokens=64))
-    costs = sorted(
-        pipeline.analyze_incident(INCIDENTS[i % len(INCIDENTS)].message)
-        ["time_cost"] for i in range(n_incidents))
-    return costs[len(costs) // 2]
+    service = AssistantService(EngineBackend(engine))
+    work: "queue.Queue[str]" = queue.Queue()
+    for i in range(n_incidents):
+        work.put(INCIDENTS[i % len(INCIDENTS)].message)
+    costs, lock = [], threading.Lock()
+
+    def drain() -> None:
+        # same shared-service drain shape as sweeps/run_file._drain_shared
+        # (which also guards per incident via _run_one) — kept local
+        # because the bench collects only time_cost against the in-memory
+        # fixtures, not the sweep's JSON record stream
+        pipeline = RCAPipeline(
+            service,
+            InMemoryGraphExecutor(build_metagraph()),
+            InMemoryGraphExecutor(build_stategraph()),
+            RCAConfig(cypher_max_new_tokens=64,
+                      analyzer_max_new_tokens=64))
+        while True:
+            try:
+                msg = work.get_nowait()
+            except queue.Empty:
+                return
+            t0 = time.time()
+            try:
+                cost = pipeline.analyze_incident(msg)["time_cost"]
+            except Exception as e:      # a failed incident must not kill
+                print(f"[bench] incident failed: {e}", file=sys.stderr)
+                cost = time.time() - t0  # the worker; count its wall time
+            with lock:
+                costs.append(cost)
+
+    threads = [threading.Thread(target=drain, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    costs.sort()
+    return [costs[len(costs) // 2], len(costs), workers]
 
 
 def _leg(expr: str, timeout: int = 560):
@@ -284,7 +319,11 @@ def main():
     (decode_tps, mfu_decode, roof_decode, prefill_tps, mfu_prefill,
      model_name, batch, quant_bits, device_str, platform) = dec
     p50_oracle = _leg("bench.bench_rca_p50()")
-    p50_engine = _leg("bench.bench_rca_p50_engine()")
+    # the real 100-incident sweep: budget scales with incident count and
+    # the tunnel's per-tick dispatch cost (~0.25 s), amortized ~8x by the
+    # worker overlap; 30 min covers compile + the sweep with margin
+    eng = _leg("bench.bench_rca_p50_engine()", timeout=1800)
+    p50_engine, n_engine, n_workers = eng if eng else (None, None, None)
     tps_8b = mfu_8b = roof_8b = None
     if platform == "tpu":
         res = _leg("list(bench.bench_8b())")
@@ -331,6 +370,8 @@ def main():
         if p50_oracle is not None else None,
         "rca_p50_engine_s": round(p50_engine, 4)
         if p50_engine is not None else None,
+        "rca_engine_incidents": n_engine,
+        "rca_engine_workers": n_workers,
         "device": device_str,
     }
     if capped:

@@ -21,11 +21,20 @@ the in-tree TPU engine instead of HTTPS to api.openai.com:
 Threads support concurrent runs from one thread (the reference serializes
 per-thread; SURVEY §3.4 notes stage 3 issues independent per-entity audits on
 a shared thread — here they can overlap in the batch).
+
+The service is thread-safe: one coarse re-entrant lock serializes every
+public method and the backend pump, so N sweep workers can drive their own
+pipelines against ONE shared service/engine and the continuous batcher
+merges their runs into shared decode ticks (the configs[2] sweep shape —
+see sweeps/run_file.py --workers).  A worker blocked on the lock while
+another worker's pump ticks the engine is not wasted time: that tick
+decodes every in-flight run, including the blocked worker's.
 """
 
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -128,6 +137,17 @@ def render_prompt(assistant: Assistant, thread: Thread,
     return "".join(parts)
 
 
+def _locked(fn):
+    """Serialize a service method on the instance's re-entrant lock."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class AssistantService:
     """The 'server': owns assistants/threads/runs and drives an LMBackend."""
 
@@ -140,12 +160,15 @@ class AssistantService:
         self._thread_runs: Dict[str, List[str]] = {}
         self._inflight: Dict[int, str] = {}   # backend handle -> run id
         self._ids = itertools.count()
+        self._lock = threading.RLock()
 
+    @_locked
     def _next_id(self, prefix: str) -> str:
         return f"{prefix}_{next(self._ids):08d}"
 
     # ------------------------------------------------------------ lifecycle
 
+    @_locked
     def create_assistant(self, instructions: str, name: str,
                          model: str = "local",
                          gen: Optional[GenOptions] = None) -> Assistant:
@@ -154,24 +177,29 @@ class AssistantService:
         self.assistants[a.id] = a
         return a
 
+    @_locked
     def retrieve_assistant(self, assistant_id: str) -> Assistant:
         return self.assistants[assistant_id]
 
+    @_locked
     def create_thread(self) -> Thread:
         t = Thread(self._next_id("thread"))
         self.threads[t.id] = t
         self._thread_runs[t.id] = []
         return t
 
+    @_locked
     def retrieve_thread(self, thread_id: str) -> Thread:
         return self.threads[thread_id]
 
+    @_locked
     def add_message(self, thread_id: str, content: str,
                     role: str = "user") -> Message:
         m = Message(self._next_id("msg"), role, content, time.time())
         self.threads[thread_id].messages.append(m)
         return m
 
+    @_locked
     def create_run(self, thread_id: str, assistant_id: str,
                    instructions: Optional[str] = None,
                    gen: Optional[GenOptions] = None) -> Run:
@@ -192,10 +220,12 @@ class AssistantService:
         METRICS.inc("serve.runs_started")
         return run
 
+    @_locked
     def retrieve_run(self, run_id: str) -> Run:
         self._pump()
         return self.runs[run_id]
 
+    @_locked
     def cancel_run(self, run_id: str) -> Run:
         run = self.runs[run_id]
         if run.status not in RunStatus.TERMINAL:
@@ -205,6 +235,7 @@ class AssistantService:
             self._inflight.pop(run.backend_handle, None)
         return run
 
+    @_locked
     def list_runs(self, thread_id: str, limit: int = 20,
                   order: str = "desc") -> List[Run]:
         ids = self._thread_runs.get(thread_id, [])
@@ -213,6 +244,7 @@ class AssistantService:
             runs = runs[::-1]
         return runs[:limit]
 
+    @_locked
     def assistant_token_usage(self, assistant_id: str, tmin: int, tmax: int,
                               limit: int = 20) -> Dict[str, int]:
         """Windowed usage over ALL of an assistant's runs (any thread) —
@@ -236,6 +268,7 @@ class AssistantService:
                     usage[k] += run.usage[k]
         return usage
 
+    @_locked
     def list_messages(self, thread_id: str, limit: Optional[int] = None
                       ) -> MessageList:
         msgs = self.threads[thread_id].messages[::-1]  # newest first
@@ -245,6 +278,7 @@ class AssistantService:
 
     # ------------------------------------------------------------ execution
 
+    @_locked
     def _pump(self) -> None:
         """Advance the backend and settle any finished runs.  O(in-flight
         runs), not O(all runs ever created)."""
@@ -279,21 +313,34 @@ class AssistantService:
                 del self._inflight[handle]
 
     def wait_run(self, run_id: str, timeout_s: Optional[float] = None) -> Run:
+        # NOT @_locked: the lock is taken per pump iteration, never for the
+        # whole wait, so concurrent waiters interleave — each tick one of
+        # them drives decodes EVERY in-flight run forward
         run = self.runs[run_id]
         t0 = time.time()
         while run.status not in RunStatus.TERMINAL:
-            self._pump()
-            if run.status in RunStatus.TERMINAL:
-                break
-            if not self.backend.busy(run.backend_handle):
-                # backend lost the handle without a result
-                run.status = RunStatus.FAILED
-                run.error = "backend dropped the run"
-                break
-            if timeout_s is not None and time.time() - t0 > timeout_s:
-                run.status = RunStatus.EXPIRED
-                run.completed_at = int(time.time())
-                break
+            with self._lock:
+                if run.status in RunStatus.TERMINAL:
+                    break
+                self._pump()
+                if run.status in RunStatus.TERMINAL:
+                    break
+                if not self.backend.busy(run.backend_handle):
+                    # backend lost the handle without a result
+                    run.status = RunStatus.FAILED
+                    run.error = "backend dropped the run"
+                    break
+                if timeout_s is not None and time.time() - t0 > timeout_s:
+                    # mirror _pump's deadline path: cancel the backend run
+                    # and drop it from _inflight, else the abandoned run
+                    # keeps occupying a batch slot and a peer worker's
+                    # later _pump would flip this EXPIRED run to COMPLETED
+                    self.backend.cancel(run.backend_handle)
+                    self._inflight.pop(run.backend_handle, None)
+                    run.status = RunStatus.EXPIRED
+                    run.completed_at = int(time.time())
+                    break
+            time.sleep(0)      # let a peer worker admit/settle between ticks
         return run
 
 

@@ -111,7 +111,17 @@ def main(argv=None) -> dict:
                              "(engine replicas pinned round-robin to local "
                              "devices) drain one incident queue "
                              "(BASELINE configs[2] pod-sweep shape)")
+    parser.add_argument("--workers", type=int, default=1,
+                        help="N worker threads sharing ONE engine/service: "
+                             "concurrent incidents' runs merge into shared "
+                             "continuous-batching decode ticks (per-chip "
+                             "batching; --replicas scales across chips)")
     args = parser.parse_args(argv)
+    if args.replicas > 1 and args.workers > 1:
+        parser.error("--replicas and --workers are mutually exclusive: "
+                     "replicas build one engine per device, workers share "
+                     "one engine (use replicas x workers via one process "
+                     "per device if both are wanted)")
 
     if not os.path.exists(args.input):
         log.info("input %s missing; writing the built-in corpus", args.input)
@@ -128,7 +138,10 @@ def main(argv=None) -> dict:
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
     start = time.time()
     n_rep = max(1, args.replicas)
-    if n_rep == 1:
+    if args.workers > 1:
+        costs, failures, per_replica = _drain_shared(args, messages,
+                                                     args.workers)
+    elif n_rep == 1:
         costs, failures, per_replica = _drain_serial(args, messages)
     else:
         costs, failures, per_replica = _drain_replicated(args, messages,
@@ -145,6 +158,8 @@ def main(argv=None) -> dict:
     }
     if per_replica is not None:
         summary["replicas"] = per_replica
+    if args.workers > 1:
+        summary["workers"] = args.workers
     print(json.dumps({k: v for k, v in summary.items() if k != "metrics"}))
     return summary
 
@@ -186,6 +201,51 @@ def _drain_serial(args, messages):
     pipeline.meta_executor.close()
     pipeline.state_executor.close()
     return costs, failures, None
+
+
+def _drain_shared(args, messages, n_workers):
+    """Shared-engine concurrent sweep: ``n_workers`` threads — each with
+    its OWN RCAPipeline (own assistants/threads, so incident conversations
+    stay isolated) — submit to ONE AssistantService/engine.  The
+    continuous batcher merges the workers' in-flight runs into shared
+    decode ticks: on dispatch-latency-dominated hosts this divides the
+    per-incident tick cost by the overlap factor, which is the configs[2]
+    per-chip story (--replicas covers the across-chip axis)."""
+    import queue
+    import threading
+
+    service = build_service(args)       # ONE engine, shared by all workers
+    work: "queue.Queue[str]" = queue.Queue()
+    for m in messages:
+        work.put(m)
+    lock = threading.Lock()
+    costs, failures = [], [0]
+
+    def drain(idx: int) -> None:
+        meta, state = build_executors(args)
+        pipeline = RCAPipeline(
+            service, meta, state, RCAConfig(model=args.model),
+            sweep=SweepConfig(input_csv=args.input,
+                              output_json=args.output))
+        while True:
+            try:
+                message = work.get_nowait()
+            except queue.Empty:
+                break
+            cost, failed = _run_one(pipeline, message, args.output, lock)
+            with lock:
+                costs.append(cost)
+                failures[0] += failed
+        meta.close()
+        state.close()
+
+    threads = [threading.Thread(target=drain, args=(i,), daemon=True)
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return costs, failures[0], None
 
 
 def _drain_replicated(args, messages, n_rep):

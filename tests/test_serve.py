@@ -71,6 +71,24 @@ def test_run_expiry():
     assert c.get_run_status().status == RunStatus.EXPIRED
 
 
+def test_wait_run_timeout_releases_backend_slot():
+    """wait_run(timeout_s=) must cancel the backend run and drop it from
+    the in-flight map (mirroring the deadline path), so the slot frees
+    and a later pump cannot flip the observed EXPIRED run to COMPLETED."""
+    tok = get_tokenizer()
+    backend = EchoBackend(tok, delay_pumps=2)
+    service = AssistantService(backend)
+    c = make_client(service)
+    c.add_message("q")
+    c.run_assistant()
+    run = service.wait_run(c.run.id, timeout_s=0.0)
+    assert run.status == RunStatus.EXPIRED
+    assert run.backend_handle not in service._inflight
+    for _ in range(5):                  # enough pumps to pass the delay
+        service._pump()
+    assert service.runs[c.run.id].status == RunStatus.EXPIRED
+
+
 def test_cancel_run(echo_service):
     c = make_client(echo_service)
     c.add_message("q")
