@@ -132,17 +132,26 @@ def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh=None,
             f"{tp_mesh.shape['data']}")
 
 
-def validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh) -> None:
+def validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh,
+                     cp_seq_axis: str = "seq") -> None:
     """EP serving preconditions: MoE model; mesh carries "data" and
     "expert" axes; decode batch and prefill buckets divide by the token
-    sharding (tokens shard over data*expert, parallel/moe.py); CP+EP in
-    one engine is unsupported (the CP prefill path is not EP-aware)."""
+    sharding (tokens shard over data*expert, parallel/moe.py).
+
+    CP composes with EP on ONE mesh carrying "data", "expert" and the
+    seq axis: CP prefill then shards MoE tokens over (seq, expert) — the
+    sequence stays put, dispatch rides the expert axis (models/llama.py
+    prefill_kv_cp) — and decode tokens shard over (data, expert) as in
+    plain EP, over the seq-sharded cache."""
     if ep_mesh is None:
         return
     if model_cfg.n_experts <= 0:
         raise ValueError("ep_mesh requires an MoE model (n_experts > 0)")
-    if cp_mesh is not None:
-        raise ValueError("ep_mesh and cp_mesh are mutually exclusive")
+    if cp_mesh is not None and cp_mesh is not ep_mesh:
+        raise ValueError(
+            "cp_mesh and ep_mesh must be the SAME composed mesh (one "
+            "Mesh carrying 'data', 'expert' and the seq axis); two "
+            "distinct meshes cannot both lay out the token sharding")
     for axis in ("data", "expert"):
         if axis not in ep_mesh.shape:
             raise ValueError(f"ep_mesh needs a '{axis}' axis, has "
@@ -156,10 +165,17 @@ def validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh) -> None:
         raise ValueError(
             f"max_batch={engine_cfg.max_batch} not divisible by "
             f"data*expert={p_tok} (decode tokens shard over both)")
+    if cp_mesh is not None:
+        # CP prefill is per-sequence (b=1): its MoE token dim is the
+        # padded sequence itself, sharded over (seq, expert)
+        p_pref = ep_mesh.shape[cp_seq_axis] * ep_mesh.shape["expert"]
+    else:
+        p_pref = p_tok
     for b in tuple(engine_cfg.prefill_buckets) + (engine_cfg.max_seq_len,):
-        if b % p_tok:
+        if b % p_pref:
             raise ValueError(
-                f"prefill bucket {b} not divisible by data*expert={p_tok}")
+                f"prefill bucket {b} not divisible by the prefill token "
+                f"sharding {p_pref}")
     if engine_cfg.paged and engine_cfg.prefix_cache \
             and engine_cfg.page_size % p_tok:
         # the prefix-cache chunked prefill runs at ANY page-multiple width
@@ -707,6 +723,7 @@ class InferenceEngine(EngineBase):
         pp_mesh=None,
         pp_microbatches: Optional[int] = None,
         pp_stage_axis: str = "stage",
+        sp: bool = False,
     ):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         then runs context-parallel over it (long-context mode; the axis
@@ -722,15 +739,26 @@ class InferenceEngine(EngineBase):
         expert-parallel path (parallel/moe.py) with experts sharded over
         "expert" (BASELINE configs[3]: Mixtral EP serving).  Requires an
         MoE model and token counts divisible by the mesh (validated
-        below)."""
+        below).
+
+        ``sp``: Megatron-style sequence parallelism inside the TP prefill
+        — the residual stream between matmul regions seq-shards over
+        "model" (llama._sp_constrain), so norms/elementwise stop
+        replicating across the TP group.  Requires ``tp_mesh``; the CP
+        modes already seq-shard activations their own way (exclusive)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
+        if sp and (tp_mesh is None or cp_mesh is not None):
+            raise ValueError("sp=True (Megatron sequence parallelism) "
+                             "requires tp_mesh and is exclusive with "
+                             "cp_mesh (CP already seq-shards activations)")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],
                 tuple(engine_cfg.prefill_buckets)
                 + (engine_cfg.max_seq_len,))
-        validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
+        validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh,
+                         cp_seq_axis)
         validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh,
                          cp_seq_axis)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
@@ -860,25 +888,30 @@ class InferenceEngine(EngineBase):
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0)
         elif cp_mesh is not None:
             # composed CP×TP names "model" so the ring/all-to-all runs per
-            # head shard instead of all-gathering TP-sharded heads
+            # head shard instead of all-gathering TP-sharded heads;
+            # composed CP×EP threads ep_mesh so MoE MLPs dispatch over
+            # (seq, expert) instead of densifying
             cp_head_axis = "model" if tp_mesh is not None else None
 
             def _prefill_cp(cfg, params, cache, toks, n, slot):
                 return llama.prefill_cp(cfg, params, cache, toks, n, slot,
                                         cp_mesh, cp_seq_axis, cp_mode,
-                                        cp_head_axis)
+                                        cp_head_axis, ep_mesh)
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:
             use_flash, flash_mesh = flash_prefill_plan(params, tp_mesh,
                                                        model_cfg, ep_mesh)
+            sp_mesh = tp_mesh if sp else None
             self._prefill = jax.jit(
                 functools.partial(llama.prefill, use_flash=use_flash,
-                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh,
+                                  sp_mesh=sp_mesh),
                 static_argnums=0)
             self._prefill_batch = jax.jit(
                 functools.partial(llama.prefill_batch, use_flash=use_flash,
-                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh,
+                                  sp_mesh=sp_mesh),
                 static_argnums=0)
         # batched admission needs the plain prefill path (prefill_cp is
         # per-sequence)

@@ -254,7 +254,7 @@ def _write_pool_pages(cfg: ModelConfig, pool: PagePool, new_k, new_v,
 def paged_prefill(cfg: ModelConfig, params, pool: PagePool,
                   tokens: jnp.ndarray, length: jnp.ndarray,
                   page_map: jnp.ndarray, use_flash: bool = False,
-                  ep_mesh=None, flash_mesh=None):
+                  ep_mesh=None, flash_mesh=None, sp_mesh=None):
     """Prefill ONE sequence, scattering its KV into ``page_map`` pages.
 
     tokens [1, S_pad] with S_pad a multiple of page_size; page_map
@@ -266,7 +266,8 @@ def paged_prefill(cfg: ModelConfig, params, pool: PagePool,
     page_size = pool.page_size
     assert s_pad % page_size == 0, (s_pad, page_size)
     new_k, new_v, logits = llama.prefill_kv(cfg, params, tokens, length,
-                                            use_flash, ep_mesh, flash_mesh)
+                                            use_flash, ep_mesh, flash_mesh,
+                                            sp_mesh)
     pool = _write_pool_pages(cfg, pool, new_k, new_v, page_map,
                              s_pad // page_size, page_size)
     return pool, logits
@@ -297,7 +298,7 @@ def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
 def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, lengths: jnp.ndarray,
                         page_maps: jnp.ndarray, use_flash: bool = False,
-                        ep_mesh=None, flash_mesh=None):
+                        ep_mesh=None, flash_mesh=None, sp_mesh=None):
     """Prefill N sequences into their pool pages in ONE dispatch.
 
     tokens [N, S_pad] right-padded (S_pad a page multiple); lengths [N];
@@ -312,7 +313,8 @@ def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
     n_seq_pages = s_pad // page_size
     new_k, new_v, logits = llama._prefill_batch_kv(cfg, params, tokens,
                                                    lengths, use_flash,
-                                                   ep_mesh, flash_mesh)
+                                                   ep_mesh, flash_mesh,
+                                                   sp_mesh)
     # fold the batch dim into the page dim: the single-sequence write
     # helper scatters [L, total_pages, page, kv] by a flat page map
     pool = _write_pool_pages(
@@ -325,7 +327,8 @@ def paged_prefill_batch(cfg: ModelConfig, params, pool: PagePool,
 def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
                      tokens: jnp.ndarray, length: jnp.ndarray,
                      page_map: jnp.ndarray, mesh, seq_axis: str = "seq",
-                     cp_mode: str = "ring", head_axis: Optional[str] = None):
+                     cp_mode: str = "ring", head_axis: Optional[str] = None,
+                     ep_mesh=None):
     """Context-parallel paged prefill: ring/Ulysses attention compute
     (llama.prefill_kv_cp, sequence sharded over ``mesh[seq_axis]``) with
     the page-scatter write — long prompts prefill across the ICI ring
@@ -336,7 +339,7 @@ def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
     assert s_pad % page_size == 0, (s_pad, page_size)
     new_k, new_v, logits = llama.prefill_kv_cp(cfg, params, tokens, length,
                                                mesh, seq_axis, cp_mode,
-                                               head_axis)
+                                               head_axis, ep_mesh)
     pool = _write_pool_pages(cfg, pool, new_k, new_v, page_map,
                              s_pad // page_size, page_size)
     return pool, logits
@@ -646,7 +649,7 @@ class PagedInferenceEngine(EngineBase):
                  cp_mesh=None, cp_seq_axis: str = "seq",
                  cp_mode: str = "ring", ep_mesh=None, tp_mesh=None,
                  pp_mesh=None, pp_microbatches: Optional[int] = None,
-                 pp_stage_axis: str = "stage"):
+                 pp_stage_axis: str = "stage", sp: bool = False):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         runs context-parallel over it (ring or Ulysses, as in the
         contiguous engine) and scatters the full-depth KV into pool pages.
@@ -656,11 +659,16 @@ class PagedInferenceEngine(EngineBase):
         context-parallel)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
+        if sp and (tp_mesh is None or cp_mesh is not None):
+            raise ValueError("sp=True (Megatron sequence parallelism) "
+                             "requires tp_mesh and is exclusive with "
+                             "cp_mesh (CP already seq-shards activations)")
         from k8s_llm_rca_tpu.engine.engine import (
             params_multi_device, validate_ep_mesh, validate_pp_mesh,
             validate_tp_mesh,
         )
-        validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh)
+        validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh,
+                         cp_seq_axis)
         validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh,
                          cp_seq_axis)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
@@ -817,13 +825,15 @@ class PagedInferenceEngine(EngineBase):
                                           donate_argnums=donate)
         elif cp_mesh is not None:
             # composed CP×TP names "model" so the ring/all-to-all runs per
-            # head shard instead of all-gathering TP-sharded heads
+            # head shard instead of all-gathering TP-sharded heads;
+            # composed CP×EP threads ep_mesh so MoE MLPs dispatch over
+            # (seq, expert) instead of densifying
             cp_head_axis = "model" if tp_mesh is not None else None
 
             def _prefill_cp(cfg, params, pool, toks, n, page_map):
                 return paged_prefill_cp(cfg, params, pool, toks, n,
                                         page_map, cp_mesh, cp_seq_axis,
-                                        cp_mode, cp_head_axis)
+                                        cp_mode, cp_head_axis, ep_mesh)
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0,
                                     donate_argnums=donate)
@@ -832,7 +842,8 @@ class PagedInferenceEngine(EngineBase):
                                                        model_cfg, ep_mesh)
             self._prefill = jax.jit(
                 functools.partial(paged_prefill, use_flash=use_flash,
-                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh,
+                                  sp_mesh=tp_mesh if sp else None),
                 static_argnums=0, donate_argnums=donate)
         if pp_mesh is None:
             if cp_mesh is not None:
@@ -843,7 +854,8 @@ class PagedInferenceEngine(EngineBase):
                                                            ep_mesh)
             self._prefill_batch = jax.jit(
                 functools.partial(paged_prefill_batch, use_flash=use_flash,
-                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh),
+                                  ep_mesh=ep_mesh, flash_mesh=flash_mesh,
+                                  sp_mesh=tp_mesh if sp else None),
                 static_argnums=0, donate_argnums=donate)
         self._prefill_chunk = jax.jit(
             functools.partial(paged_prefill_chunk, ep_mesh=ep_mesh),

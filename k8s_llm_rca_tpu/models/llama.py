@@ -210,20 +210,25 @@ def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
 
 
 def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
-         ep_mesh=None) -> jnp.ndarray:
+         ep_mesh=None, ep_token_axis: str = "data") -> jnp.ndarray:
     """``ep_mesh``: optional Mesh with an "expert" axis — the MoE block then
     dispatches through the all-to-all expert-parallel path
     (parallel/moe.expert_parallel_moe) instead of the dense soft-dispatch.
     Lossless capacity (capacity_factor = n_experts) so serving under EP
     computes the same function as the dense form; engines bind this at
-    construction (BASELINE configs[3]: Mixtral expert-parallel serving)."""
+    construction (BASELINE configs[3]: Mixtral expert-parallel serving).
+    ``ep_token_axis``: mesh axis the flattened token dim shards over
+    alongside "expert" — "data" for batch prefill/decode, the CP seq axis
+    under context-parallel prefill (the sequence stays put; dispatch rides
+    the expert axis only)."""
     if cfg.n_experts > 0:
         if ep_mesh is not None:
             from k8s_llm_rca_tpu.parallel.moe import expert_parallel_moe
 
             return expert_parallel_moe(
                 x, layer, ep_mesh, top_k=cfg.n_experts_per_tok,
-                capacity_factor=float(cfg.n_experts))
+                capacity_factor=float(cfg.n_experts),
+                data_axis=ep_token_axis)
         return _moe_mlp(cfg, layer, x)
     gate = jax.nn.silu(x @ dq(layer["w_gate"]))
     up = x @ dq(layer["w_up"])
@@ -255,14 +260,34 @@ def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
                       dense_w.astype(x.dtype))
 
 
+def _sp_constrain(x: jnp.ndarray, sp_mesh) -> jnp.ndarray:
+    """Megatron-style sequence parallelism between TP regions: constrain
+    the residual stream's SEQUENCE dim to shard over the TP axis
+    ("model").  Norms/elementwise then run on 1/t of the tokens instead
+    of replicating, and GSPMD lowers each TP all-reduce into the
+    reduce-scatter + all-gather pair around the matmul regions — same
+    communication volume, 1/t the activation memory and pointwise
+    compute.  No-op when ``sp_mesh`` is None."""
+    if sp_mesh is None:
+        return x
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(sp_mesh, P(None, "model", None)))
+
+
 def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
-                   attention_fn=None, ep_mesh=None):
+                   attention_fn=None, ep_mesh=None,
+                   ep_token_axis: str = "data", sp_mesh=None):
     """One transformer block over a full sequence.  ``attention_fn``
     defaults to masked causal attention (always safe: differentiable for
     training, GSPMD-partitionable for TP); inference prefill passes the
     Pallas flash kernel via ``prefill_kv(use_flash=True)`` and the
     context-parallel prefill passes ring attention (same (q, k, v) -> out
-    contract)."""
+    contract).  ``sp_mesh``: Megatron-style SP — the residual stream
+    seq-shards over "model" at both norm points (_sp_constrain)."""
+    x = _sp_constrain(x, sp_mesh)
     h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q, k, v = _qkv(cfg, layer, h, angles, positions)
     if attention_fn is None:
@@ -271,8 +296,9 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
         attn = attention_fn(q, k, v)
     b, s, _, _ = attn.shape
     x = x + attn.reshape(b, s, cfg.q_dim) @ dq(layer["wo"])
+    x = _sp_constrain(x, sp_mesh)
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-    x = x + _mlp(cfg, layer, h, ep_mesh)
+    x = x + _mlp(cfg, layer, h, ep_mesh, ep_token_axis)
     return x, k, v
 
 
@@ -361,8 +387,12 @@ def _logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
             seq_lens: Optional[jnp.ndarray] = None,
-            ep_mesh=None) -> jnp.ndarray:
-    """Training/scoring forward: tokens [B, S] -> logits [B, S, V] (fp32)."""
+            ep_mesh=None, sp_mesh=None) -> jnp.ndarray:
+    """Training/scoring forward: tokens [B, S] -> logits [B, S, V] (fp32).
+
+    ``sp_mesh``: Megatron-style sequence parallelism — under TP, the
+    residual stream between matmul regions seq-shards over "model"
+    (_sp_constrain); pass the TP mesh."""
     b, s = tokens.shape
     if seq_lens is None:
         seq_lens = jnp.full((b,), s, jnp.int32)
@@ -371,7 +401,7 @@ def forward(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     x = gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
     for layer in params["layers"]:
         x, _, _ = _block_prefill(cfg, layer, x, angles, positions, seq_lens,
-                                 ep_mesh=ep_mesh)
+                                 ep_mesh=ep_mesh, sp_mesh=sp_mesh)
     return _logits(cfg, params, x)
 
 
@@ -394,7 +424,7 @@ def _flash_attention_fn(seq_lens, flash_mesh):
 
 def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
                length: jnp.ndarray, use_flash: bool = False,
-               ep_mesh=None, flash_mesh=None
+               ep_mesh=None, flash_mesh=None, sp_mesh=None
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared prefill compute for both cache designs (contiguous slot write
     below, page scatter in engine/paged.py): run the stack over ONE
@@ -424,7 +454,7 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     ks, vs = [], []
     for layer in params["layers"]:
         x, k, v = _block_prefill(cfg, layer, x, angles, positions, seq_lens,
-                                 attention_fn, ep_mesh)
+                                 attention_fn, ep_mesh, sp_mesh=sp_mesh)
         ks.append(k[0])  # [S_pad, n_kv, d]
         vs.append(v[0])
 
@@ -435,7 +465,8 @@ def prefill_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 
 def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
             tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
-            use_flash: bool = False, ep_mesh=None, flash_mesh=None
+            use_flash: bool = False, ep_mesh=None, flash_mesh=None,
+            sp_mesh=None
             ) -> Tuple[KVCache, jnp.ndarray]:
     """Prefill ONE sequence into cache slot ``slot``.
 
@@ -446,7 +477,7 @@ def prefill(cfg: ModelConfig, params: Params, cache: KVCache,
     per-head-shard under this TP mesh (ops.flash_attention_sharded).
     """
     new_k, new_v, logits = prefill_kv(cfg, params, tokens, length, use_flash,
-                                      ep_mesh, flash_mesh)
+                                      ep_mesh, flash_mesh, sp_mesh)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 
@@ -607,7 +638,8 @@ def decode_multi(cfg: ModelConfig, params: Params, cache: KVCache,
 
 def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
                   length: jnp.ndarray, mesh, seq_axis: str = "seq",
-                  cp_mode: str = "ring", head_axis: Optional[str] = None
+                  cp_mode: str = "ring", head_axis: Optional[str] = None,
+                  ep_mesh=None
                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Context-parallel prefill: ``prefill_kv`` with the sequence sharded
     over ``mesh[seq_axis]``.
@@ -631,6 +663,13 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     CP×TP composition (TP-sharded params produce head-sharded q/k/v;
     naming the axis keeps the ring/all-to-all per head shard instead of
     all-gathering heads at the shard_map boundary).
+
+    ``ep_mesh``: the CP×EP composition — MoE MLPs dispatch through the
+    all-to-all expert path with the flattened sequence as the token dim,
+    sharded over (seq_axis, "expert"): each seq shard's tokens subdivide
+    over the expert group, so the sequence never moves and the dispatch
+    all-to-all rides the expert axis only.  Must be the SAME composed
+    mesh as ``mesh`` (engine-validated).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -653,7 +692,8 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     ks, vs = [], []
     for layer in params["layers"]:
         x, k, v = _block_prefill(cfg, layer, x, angles, positions,
-                                 seq_lens=None, attention_fn=attn)
+                                 seq_lens=None, attention_fn=attn,
+                                 ep_mesh=ep_mesh, ep_token_axis=seq_axis)
         ks.append(k[0])
         vs.append(v[0])
 
@@ -665,18 +705,19 @@ def prefill_kv_cp(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 def prefill_cp(cfg: ModelConfig, params: Params, cache: KVCache,
                tokens: jnp.ndarray, length: jnp.ndarray, slot: jnp.ndarray,
                mesh, seq_axis: str = "seq", cp_mode: str = "ring",
-               head_axis: Optional[str] = None
+               head_axis: Optional[str] = None, ep_mesh=None
                ) -> Tuple[KVCache, jnp.ndarray]:
     """Context-parallel variant of ``prefill``: same cache-write contract,
     ring/Ulysses attention compute (see prefill_kv_cp)."""
     new_k, new_v, logits = prefill_kv_cp(cfg, params, tokens, length, mesh,
-                                         seq_axis, cp_mode, head_axis)
+                                         seq_axis, cp_mode, head_axis,
+                                         ep_mesh)
     return _write_prefill_kv(cfg, cache, new_k, new_v, slot), logits
 
 
 def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
                       lengths: jnp.ndarray, use_flash: bool = False,
-                      ep_mesh=None, flash_mesh=None
+                      ep_mesh=None, flash_mesh=None, sp_mesh=None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched prefill forward WITHOUT a cache write: tokens [N, S_pad]
     right-padded, lengths [N] -> (new_k [L, N, S_pad, kv_dim], new_v,
@@ -694,7 +735,7 @@ def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
     ks, vs = [], []
     for layer in params["layers"]:
         x, k, v = _block_prefill(cfg, layer, x, angles, positions, lengths,
-                                 attention_fn, ep_mesh)
+                                 attention_fn, ep_mesh, sp_mesh=sp_mesh)
         ks.append(k.reshape(n, s_pad, cfg.kv_dim))   # [N, S_pad, kv]
         vs.append(v.reshape(n, s_pad, cfg.kv_dim))
 
@@ -707,7 +748,7 @@ def _prefill_batch_kv(cfg: ModelConfig, params: Params, tokens: jnp.ndarray,
 def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
                   tokens: jnp.ndarray, lengths: jnp.ndarray,
                   slots: jnp.ndarray, use_flash: bool = False, ep_mesh=None,
-                  flash_mesh=None
+                  flash_mesh=None, sp_mesh=None
                   ) -> Tuple[KVCache, jnp.ndarray]:
     """Prefill N sequences into their cache slots in ONE dispatch.
 
@@ -720,7 +761,8 @@ def prefill_batch(cfg: ModelConfig, params: Params, cache: KVCache,
     """
     _, s_pad = tokens.shape
     new_k, new_v, logits = _prefill_batch_kv(cfg, params, tokens, lengths,
-                                             use_flash, ep_mesh, flash_mesh)
+                                             use_flash, ep_mesh, flash_mesh,
+                                             sp_mesh)
     if cache.quantized:
         packed = _kv_packed(cfg, cache)
         new_k, k_s = _quantize_kv(new_k, packed)     # scales [L, N, S_pad]

@@ -34,13 +34,16 @@ from k8s_llm_rca_tpu.models.llama import (  # noqa: F401
 )
 
 
-def build_ep_mesh(n_expert_shards: int, n_data: int = 1,
+def build_ep_mesh(n_expert_shards: int, n_data: int = 1, n_seq: int = 1,
                   devices: Optional[Sequence] = None):
-    """(data, expert) mesh for EP serving; ``n_expert_shards`` devices hold
-    disjoint expert subsets, ``n_data`` replicas shard the token batch."""
+    """(data, expert[, seq]) mesh for EP serving; ``n_expert_shards``
+    devices hold disjoint expert subsets, ``n_data`` replicas shard the
+    token batch, ``n_seq`` > 1 adds the context-parallel axis for the
+    CP×EP composition (pass the mesh as BOTH ep_mesh and cp_mesh)."""
     from k8s_llm_rca_tpu.runtime.mesh import build_mesh
 
-    return build_mesh(MeshConfig(data=n_data, expert=n_expert_shards),
+    return build_mesh(MeshConfig(data=n_data, expert=n_expert_shards,
+                                 seq=n_seq),
                       devices=devices)
 
 
