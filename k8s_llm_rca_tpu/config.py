@@ -235,6 +235,14 @@ class RCAConfig:
     # pipeline reranker — the rerank result then shapes prompt CONTENT,
     # not just record order (BASELINE configs[4])
     rerank_fields_top_k: int = 0
+    # start every incident on FRESH stage threads (templates/rules
+    # re-seeded).  The reference reuses one monotonically growing thread
+    # per assistant across a whole sweep (test_with_file.py loops over
+    # setup-once assistants) — viable only against a remote model with
+    # effectively unbounded context; with an in-tree engine whose
+    # max_seq_len is a real KV budget, long sweeps need re-anchoring.
+    # Retry-with-feedback WITHIN an incident still accumulates.
+    fresh_threads: bool = False
 
 
 @dataclass(frozen=True)

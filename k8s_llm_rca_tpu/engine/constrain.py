@@ -1042,13 +1042,25 @@ def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
     if isinstance(name, dict):
         if name.get("type") in ("choice", "seq"):
             # raw-text template grammars (e.g. the per-incident Cypher
-            # skeleton) are typically ONE-SHOT: the DFA compile + its
-            # per-tokenizer cache assume schema reuse across thousands of
-            # runs, so compiling one state per template character per
-            # request would pay seconds + up to 256MB of tables for
-            # nothing.  The interpreted FSM decodes these O(1) per token
-            # (forced spans; the mask build runs only at divergence
-            # points).
+            # skeleton) are typically ONE-SHOT, so the DFA compile is pure
+            # overhead for THAT run — but an interpreted slot degrades the
+            # engine's WHOLE batch to per-token stepwise ticks
+            # (_scan_chunk), which on dispatch-latency-dominated hosts
+            # costs far more than the compile (observed: the shared-engine
+            # sweep serialized onto host ticks whenever any stage-2
+            # skeleton was in flight).  Compile when the estimated table
+            # (one state per template char x vocab) stays small; fall back
+            # to the interpreted FSM above that or on compile refusal.
+            import json as _json
+
+            est_states = len(_json.dumps(name, default=str))
+            if est_states * tokenizer.vocab_size * 5 <= \
+                    _DFA_TEMPLATE_TABLE_BYTES:
+                try:
+                    return DFAGrammar(name, tokenizer)
+                except (ValueError, MemoryError) as e:
+                    get_logger(__name__).info(
+                        "template DFA unavailable (%s); interpreted", e)
             return SchemaGrammar(name, tokenizer)
         # prefer the compiled DFA (tables cached per tokenizer; enables the
         # engines' on-device constrained scan); fall back to the
@@ -1124,6 +1136,13 @@ _DFA_REJECT = -1
 # FSM instead of allocating unbounded [S, V] arrays
 _DFA_MAX_TABLE_BYTES = 256 * 1024 * 1024
 _DFA_FAR = np.int32(1 << 30)
+
+# table budget for ONE-SHOT template grammars (choice/seq): smaller than
+# _DFA_MAX_TABLE_BYTES because the compile amortizes over a single run —
+# at 32 MB a 512-token test vocab admits ~13k template chars while a 32k
+# production vocab flips long templates to the interpreted FSM (where the
+# compile would cost minutes)
+_DFA_TEMPLATE_TABLE_BYTES = 32 << 20
 
 
 class DFATables:
@@ -1302,9 +1321,11 @@ def _dfa_cache_get(schema: Dict, tokenizer: Tokenizer) -> DFATables:
         cache = {}
         tokenizer._dfa_tables_cache = cache
     tables = cache.get(key)
-    if isinstance(tables, str):
-        raise ValueError(tables)          # negative-cached compile refusal
     if tables is not None:
+        cache[key] = cache.pop(key)       # LRU refresh: hot schemas (the
+        # per-stage plan/report) must survive one-shot skeleton churn
+        if isinstance(tables, str):
+            raise ValueError(tables)      # negative-cached compile refusal
         return tables
     try:
         tables = compile_schema_dfa(schema, tokenizer)

@@ -430,11 +430,84 @@ class EngineBase:
                 remaining[slot] = self._budget_remaining(st)
         return states, remaining
 
-    def _active_dfa_tables(self):
-        """The shared DFA tables of this tick's grammar slots (None when
-        no grammar slot is active; _scan_chunk guarantees uniformity)."""
-        return next((st.grammar.tables for st in self._active.values()
-                     if st.grammar is not None), None)
+    _DFA_FUSE_BUCKET = 1024   # fused state-count rounding (compile reuse)
+
+    def _scan_dfa_setup(self):
+        """Fused DFA tables + per-slot state/budget vectors for this tick.
+
+        DISTINCT compiled grammars fuse into ONE scan state space: each
+        table's states are relabeled by a fixed offset (token_next entries
+        are in-table state ids, so adding the offset keeps every
+        transition inside its own region), the [S_i, V] tables stack along
+        the state axis, and each slot's scan state carries its table's
+        offset.  A mixed batch — e.g. planner, Cypher-skeleton and
+        reporter schemas in flight at once from different sweep workers —
+        then decodes inside one jitted scan instead of degrading to
+        per-token host ticks.  The stacked size rounds up to
+        ``_DFA_FUSE_BUCKET`` with dead rows (never indexed) so distinct
+        grammar combinations share scan compilations.
+
+        Returns None when no grammar slot is active, else
+        ((allow, next, dist, close, complete) device arrays,
+        states [B] int32, remaining [B] int32)."""
+        tabs, seen = [], set()
+        for st in self._active.values():
+            if st.grammar is not None:
+                t = st.grammar.tables
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    tabs.append(t)
+        if not tabs:
+            return None
+        tabs.sort(key=id)
+        key = tuple(id(t) for t in tabs)
+        cache = getattr(self, "_dfa_fused", None)
+        if cache is None:
+            cache = self._dfa_fused = {}
+        entry = cache.get(key)
+        if entry is not None:
+            cache[key] = cache.pop(key)   # LRU refresh: the hot combo must
+            # survive one-shot per-incident skeleton combos churning by
+        if entry is None:
+            offsets, off = {}, 0
+            allow, nxt, dist, close, complete = [], [], [], [], []
+            for t in tabs:
+                offsets[id(t)] = off
+                allow.append(t.allow)
+                nxt.append(t.token_next.astype(np.int32) + np.int32(off))
+                dist.append(t.dist)
+                close.append(t.close_tok)
+                complete.append(t.complete)
+                off += t.n_states
+            v = allow[0].shape[1]
+            pad = -(-off // self._DFA_FUSE_BUCKET) * self._DFA_FUSE_BUCKET \
+                - off
+            if pad:
+                allow.append(np.zeros((pad, v), bool))
+                nxt.append(np.zeros((pad, v), np.int32))
+                dist.append(np.zeros((pad,), np.int32))
+                close.append(np.zeros((pad,), np.int32))
+                complete.append(np.zeros((pad,), bool))
+            entry = ((jnp.asarray(np.concatenate(allow)),
+                      jnp.asarray(np.concatenate(nxt)),
+                      jnp.asarray(np.concatenate(dist)),
+                      jnp.asarray(np.concatenate(close)),
+                      jnp.asarray(np.concatenate(complete))),
+                     offsets, tabs[0].free_state, tuple(tabs))
+            # bound device residency; the kept tabs tuple pins id()s
+            while len(cache) >= 4:
+                cache.pop(next(iter(cache)))
+            cache[key] = entry
+        dev, offsets, free, _pin = entry
+        b = self.engine_cfg.max_batch
+        states = np.full((b,), free, np.int32)
+        remaining = np.full((b,), np.int32(1 << 30), np.int32)
+        for slot, st in self._active.items():
+            if st.grammar is not None:
+                states[slot] = (offsets[id(st.grammar.tables)]
+                                + st.grammar.state)
+                remaining[slot] = self._budget_remaining(st)
+        return dev, states, remaining
 
     def _grammar_post_commit(self, slot: int, token: int) -> None:
         """Keep host grammar FSMs in lockstep with scan-emitted tokens."""
@@ -445,28 +518,41 @@ class EngineBase:
     def _scan_chunk(self) -> int:
         """Device decode steps to run in ONE dispatch this tick.
 
-        The scan path amortizes per-dispatch host latency over many steps;
-        it applies only when per-token host work isn't needed: no grammar
-        masks, no queued admissions waiting on a free slot.  The chunk is
-        the largest power of two <= decode_chunk that no slot's token
-        budget (or subclass bound) cuts short, so budget boundaries still
-        land exactly (stop strings/EOS inside a chunk are trimmed after
-        the fact, same text semantics as the stepwise path)."""
+        The scan path amortizes per-dispatch host latency over many
+        steps; only an interpreted (non-DFA) grammar forces stepwise
+        ticks (it needs per-token host masks).  Mixed DFA grammars fuse
+        into one scan state space (_scan_dfa_setup), and queued
+        admissions do NOT force stepwise: admission happens at the next
+        step() either way, so draining the queue with per-token ticks
+        would only add dispatches (pathological on dispatch-latency-
+        dominated hosts).  The chunk is the largest power of two <=
+        decode_chunk that fits every slot's CACHE headroom and subclass
+        bound; per-slot token budgets deliberately do NOT bound it (DFA
+        slots force-close in-scan, plain slots' over-decoded tokens are
+        never committed — see the inline comment), and stop strings/EOS
+        inside a chunk are trimmed after the fact, same text semantics
+        as the stepwise path."""
         limit = self.engine_cfg.decode_chunk
-        if limit <= 1 or self._pending:
+        if limit <= 1:
             return 1
-        tables = None
         for slot, st in self._active.items():
             if st.grammar is not None:
                 t = getattr(st.grammar, "tables", None)
                 if t is None or not self._dfa_scan:
                     return 1           # interpreted FSM: per-token host work
-                if tables is None:
-                    tables = t
-                elif t is not tables:
-                    return 1           # mixed grammars: no shared state space
-            limit = min(limit, self._budget_remaining(st),
-                        self._chunk_bound(slot))
+            # bound by CACHE headroom (never write past max_seq_len), NOT
+            # by the slot's token budget: DFA slots enforce budgets
+            # in-scan (the `remaining` vector force-closes), and a plain
+            # slot's tokens past its budget are simply never committed
+            # (_commit_scanned stops at the finish reason).  Min-ing the
+            # budget here let any near-finished straggler collapse the
+            # whole batch's chunk to 1 — with B staggered short-budget
+            # runs, SOME slot is almost always in its tail, so the scan
+            # degenerated to per-token dispatches exactly when the batch
+            # was busiest (observed on the shared-engine sweep).
+            headroom = self.engine_cfg.max_seq_len - (
+                st.prompt_tokens + len(st.generated))
+            limit = min(limit, max(1, headroom), self._chunk_bound(slot))
         chunk = 1
         while chunk * 2 <= limit:
             chunk *= 2
@@ -1152,18 +1238,17 @@ class InferenceEngine(EngineBase):
         Grammar slots whose FSM compiled to DFA tables run constrained
         INSIDE the scan (decode_scan_dfa) — zero per-token host work."""
         active_slots = list(self._active)
-        tables = self._active_dfa_tables()
+        setup = self._scan_dfa_setup()
         self._key, sub = jax.random.split(self._key)
-        if tables is None:
+        if setup is None:
             with METRICS.timer("engine.decode_step"):
                 self.cache, toks, self.lengths = self._decode_scan(
                     self.model_cfg, self.params, self.cache,
                     self.cur_tokens, self.lengths, sub, chunk,
                     self.sampling, self.tokenizer.eos_id)
         else:
-            (allow_t, next_t, dist_t, close_t, complete_t,
-             _) = self._dfa_device_tables(tables)
-            states, remaining = self._dfa_scan_vectors(tables)
+            (allow_t, next_t, dist_t, close_t, complete_t), states, \
+                remaining = setup
             with METRICS.timer("engine.decode_step"):
                 self.cache, toks, self.lengths, _ = self._decode_scan_dfa(
                     self.model_cfg, self.params, self.cache,

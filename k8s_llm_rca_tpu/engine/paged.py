@@ -1028,9 +1028,9 @@ class PagedInferenceEngine(EngineBase):
     def _scan_tick(self, chunk: int, active_slots) -> List[SequenceResult]:
         """Commit ``chunk`` paged decode steps from one on-device scan;
         accounting identical to the stepwise tick (shared commit loop)."""
-        tables = self._active_dfa_tables()
+        setup = self._scan_dfa_setup()
         self._key, sub = jax.random.split(self._key)
-        if tables is None:
+        if setup is None:
             with METRICS.timer("engine.decode_step"):
                 self.pool, toks, _ = self._decode_scan(
                     self.model_cfg, self.params, self.pool,
@@ -1040,9 +1040,8 @@ class PagedInferenceEngine(EngineBase):
                     self.sampling, self.tokenizer.eos_id,
                     use_kernel=self.use_kernel)
         else:
-            allow_t, next_t, dist_t, close_t, complete_t, _ = \
-                self._dfa_device_tables(tables)
-            states, remaining = self._dfa_scan_vectors(tables)
+            (allow_t, next_t, dist_t, close_t, complete_t), states, \
+                remaining = setup
             with METRICS.timer("engine.decode_step"):
                 self.pool, toks, _, _ = self._decode_scan_dfa(
                     self.model_cfg, self.params, self.pool,

@@ -75,7 +75,7 @@ def make_ep_engine(cfg: ModelConfig, engine_cfg: EngineConfig, params,
     if mesh is None:
         if n_expert_shards is None:
             n_expert_shards = len(devices or jax.devices()) // n_data
-        mesh = build_ep_mesh(n_expert_shards, n_data, devices)
+        mesh = build_ep_mesh(n_expert_shards, n_data, devices=devices)
     sharded = shard_params_ep(cfg, params, mesh)
     return make_engine(cfg, engine_cfg, sharded, tokenizer, ep_mesh=mesh,
                        **engine_kw)

@@ -87,9 +87,7 @@ def setup_state_semantic_analyzer(service: AssistantService,
     analyzer.create_assistant(
         ANALYZER_INSTRUCTIONS, "k8s-state-semantic-analyzer", model,
         gen=GenOptions(max_new_tokens=max_new_tokens))
-    analyzer.create_thread()
-    analyzer.add_message(STATE_RULE)
-    analyzer.add_message(TASK_PROTOCOL)
+    seed_analyzer_thread(analyzer)
     # the summary run uses a SEPARATE assistant whose decode is schema-
     # constrained to the report shape; it runs ON the analyzer's thread so
     # it sees every audit exchange (the per-entity audits stay free text)
@@ -100,6 +98,15 @@ def setup_state_semantic_analyzer(service: AssistantService,
                        grammar=report_schema()))
     analyzer.reporter = reporter
     return analyzer
+
+
+def seed_analyzer_thread(analyzer: GenericAssistant) -> None:
+    """Fresh analyzer thread seeded with the STATE rule + task protocol
+    (reference analyze_root_cause.py:20-43); shared by setup and the
+    per-incident thread reset (RCAPipeline.reset_threads)."""
+    analyzer.create_thread()
+    analyzer.add_message(STATE_RULE)
+    analyzer.add_message(TASK_PROTOCOL)
 
 
 # ---------------------------------------------------------------------------

@@ -92,12 +92,19 @@ def setup_cypher_generator(service: AssistantService,
         gen=GenOptions(max_new_tokens=max_new_tokens,
                        forced_prefix="```cypher\n", stop=("```",),
                        suffix="\n```"))
+    seed_generation_template(gen)
+    return gen
+
+
+def seed_generation_template(gen: GenericAssistant) -> None:
+    """Fresh thread pre-loaded with the labeled few-shot template
+    (reference generate_query.py:37-41); shared by setup and the
+    per-incident thread reset (RCAPipeline.reset_threads)."""
     gen.create_thread()
     gen.add_message(
         "Label the following prompt template generation-template-1; use it "
         "for every cypher generation request that references it.")
     gen.add_message(GENERATION_TEMPLATE)
-    return gen
 
 
 def extend_metapath_construct_string(partial_path) -> str:

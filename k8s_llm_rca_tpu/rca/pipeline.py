@@ -60,6 +60,15 @@ class RCAPipeline:
             self.service, self.cfg.model,
             max_new_tokens=self.cfg.analyzer_max_new_tokens)
 
+    def reset_threads(self) -> None:
+        """Fresh stage threads with their seeds re-applied: bounds prompt
+        growth for long sweeps (cfg.fresh_threads runs this per incident).
+        The old threads stay in the service store, so windowed token
+        accounting over past runs (get_token_usage) is unaffected."""
+        self.locator.create_thread()
+        cyphergen.seed_generation_template(self.cypher_generator)
+        auditor.seed_analyzer_thread(self.analyzer)
+
     # ------------------------------------------------------------ stage 1
 
     def plan_destination(self, error_message: str, src_kind: str
@@ -153,6 +162,8 @@ class RCAPipeline:
         """One incident end-to-end; returns the batch-driver result dict
         (schema of test_with_file.py:67-204)."""
         t0 = time.time()
+        if self.cfg.fresh_threads:
+            self.reset_threads()
         result: IncidentResult = {"error_message": error_message}
         with METRICS.timer("rca.incident"):
             src_kind = locator.find_srcKind(self.state_executor, error_message)

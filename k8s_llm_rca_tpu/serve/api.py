@@ -161,6 +161,7 @@ class AssistantService:
         self._inflight: Dict[int, str] = {}   # backend handle -> run id
         self._ids = itertools.count()
         self._lock = threading.RLock()
+        self._waiters = 0       # concurrent wait_run count (handoff sleep)
 
     @_locked
     def _next_id(self, prefix: str) -> str:
@@ -318,6 +319,16 @@ class AssistantService:
         # them drives decodes EVERY in-flight run forward
         run = self.runs[run_id]
         t0 = time.time()
+        with self._lock:               # += is not atomic across threads
+            self._waiters += 1
+        try:
+            return self._wait_run_loop(run, t0, timeout_s)
+        finally:
+            with self._lock:
+                self._waiters -= 1
+
+    def _wait_run_loop(self, run: Run, t0: float,
+                       timeout_s: Optional[float]) -> Run:
         while run.status not in RunStatus.TERMINAL:
             with self._lock:
                 if run.status in RunStatus.TERMINAL:
@@ -340,7 +351,15 @@ class AssistantService:
                     run.status = RunStatus.EXPIRED
                     run.completed_at = int(time.time())
                     break
-            time.sleep(0)      # let a peer worker admit/settle between ticks
+            # with PEER waiters, a REAL sleep (not sleep(0)): lock release
+            # does not hand off — this thread would re-acquire before a
+            # peer blocked on create_run/add_message gets scheduled,
+            # serializing the whole sweep onto one worker's runs.  1 ms
+            # against multi-ms pump ticks guarantees handoff; the
+            # single-waiter case skips the sleep entirely (no contention
+            # to break, and +1 ms per tick would tax fast backends).
+            if self._waiters > 1:
+                time.sleep(0.001)
         return run
 
 

@@ -56,6 +56,12 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
                         help="bolt://host:port for a live stategraph")
     parser.add_argument("--neo4j-auth", default="neo4j:neo4j",
                         help="user:password for live Neo4j")
+    parser.add_argument("--fresh-threads", action="store_true",
+                        help="start each incident on fresh stage threads "
+                             "(re-seeded templates/rules) instead of the "
+                             "reference's ever-growing sweep threads — "
+                             "recommended for --backend engine sweeps, "
+                             "whose max_seq_len is a real KV budget")
 
 
 def build_service(args) -> AssistantService:

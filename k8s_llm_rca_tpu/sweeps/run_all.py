@@ -37,7 +37,8 @@ def main(argv=None) -> None:
     service = build_service(args)
     meta, state = build_executors(args)
     pipeline = RCAPipeline(service, meta, state,
-                           RCAConfig(model=args.model))
+                           RCAConfig(model=args.model,
+                      fresh_threads=args.fresh_threads))
 
     start = time.time()
     failures = 0

@@ -168,7 +168,8 @@ def _build_pipeline(args):
     service = build_service(args)
     meta, state = build_executors(args)
     return RCAPipeline(
-        service, meta, state, RCAConfig(model=args.model),
+        service, meta, state, RCAConfig(model=args.model,
+                      fresh_threads=args.fresh_threads),
         sweep=SweepConfig(input_csv=args.input, output_json=args.output))
 
 
@@ -224,7 +225,8 @@ def _drain_shared(args, messages, n_workers):
     def drain(idx: int) -> None:
         meta, state = build_executors(args)
         pipeline = RCAPipeline(
-            service, meta, state, RCAConfig(model=args.model),
+            service, meta, state, RCAConfig(model=args.model,
+                      fresh_threads=args.fresh_threads),
             sweep=SweepConfig(input_csv=args.input,
                               output_json=args.output))
         while True:

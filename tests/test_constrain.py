@@ -466,6 +466,70 @@ class TestCompiledDFA:
         for f, r in zip(fids, ref_ids):
             assert res[f].token_ids == ref[r].token_ids
 
+    def test_engine_scan_fuses_heterogeneous_grammars(self):
+        """Slots carrying DIFFERENT compiled schemas decode in ONE fused
+        scan (offset-relabeled stacked tables) instead of degrading to
+        stepwise ticks, and emit exactly what a stepwise engine emits.
+        This is the shared-engine sweep shape: planner/reporter schemas
+        from different workers in flight at once."""
+        tok = get_tokenizer()
+        other_schema = {"type": "object", "properties": [
+            ("verdict", {"enum": ["healthy", "broken"]}),
+            ("score", {"type": "integer", "max_digits": 2}),
+        ]}
+        cfg = TINY.replace(max_seq_len=256)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+        def run(chunk):
+            ecfg = EngineConfig(max_batch=3, max_seq_len=256,
+                                prefill_buckets=(32,), max_new_tokens=200,
+                                temperature=0.0, decode_chunk=chunk)
+            eng = InferenceEngine(cfg, ecfg, params, tok)
+            a = eng.submit(tok.encode("plan", add_bos=True),
+                           grammar=make_grammar(PLAN_SCHEMA, tok),
+                           max_new_tokens=200)
+            b = eng.submit(tok.encode("verdict", add_bos=True),
+                           grammar=make_grammar(other_schema, tok),
+                           max_new_tokens=64)
+            c = eng.submit(tok.encode("free text", add_bos=True),
+                           max_new_tokens=24)
+            res = {r.seq_id: r for r in eng.run_to_completion()}
+            return eng, (res[a], res[b], res[c])
+
+        eng_scan, scan = run(chunk=8)
+        _, step = run(chunk=1)
+        for s, t in zip(scan, step):
+            assert s.token_ids == t.token_ids
+        assert json.loads(scan[0].text)["DestinationKind"] in KINDS
+        v = json.loads(scan[1].text)
+        assert v["verdict"] in ("healthy", "broken")
+        # the fused path actually ran: one cache entry stacking BOTH tables
+        fused = getattr(eng_scan, "_dfa_fused", {})
+        assert any(len(key) == 2 for key in fused), list(fused)
+
+    def test_engine_scan_continues_with_queued_admissions(self):
+        """A full engine with pendings queued keeps taking chunked scan
+        ticks (queued admissions no longer force per-token ticks); queued
+        work still admits and completes, greedy-identical to stepwise."""
+        tok = get_tokenizer()
+        cfg = TINY.replace(max_seq_len=128)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+        def run(chunk):
+            ecfg = EngineConfig(max_batch=2, max_seq_len=128,
+                                prefill_buckets=(32,), max_new_tokens=24,
+                                temperature=0.0, decode_chunk=chunk)
+            eng = InferenceEngine(cfg, ecfg, params, tok)
+            ids = [eng.submit(tok.encode(p, add_bos=True),
+                              max_new_tokens=24)
+                   for p in ("alpha", "beta", "gamma", "delta", "epsilon")]
+            res = {r.seq_id: r for r in eng.run_to_completion()}
+            return [res[i] for i in ids]
+
+        scan, step = run(chunk=8), run(chunk=1)
+        for s, t in zip(scan, step):
+            assert s.token_ids == t.token_ids
+
     def test_engine_budget_force_close_on_device(self):
         """Tight budgets force-close THROUGH the scan: output still parses."""
         tok = get_tokenizer()
@@ -672,22 +736,30 @@ def test_choice_dedups_by_value_and_seq_rejects_empty():
         _compile_schema({"type": "seq", "items": []})
 
 
-def test_choice_grammar_skips_dfa_compile():
-    """Template grammars are one-shot (per-request text baked in): they
-    must route to the interpreted FSM, never paying the DFA compile, and
-    force agreed spans in multi-char tokens (O(1) per tick)."""
-    from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
+def test_template_grammar_dfa_policy():
+    """Small template (choice/seq) grammars now COMPILE to DFA tables so
+    they ride the fused on-device scan (an interpreted slot would force
+    the whole shared batch to stepwise host ticks); templates whose
+    estimated table exceeds the one-shot budget still route to the
+    interpreted FSM, which forces agreed spans O(1) per tick."""
+    from k8s_llm_rca_tpu.engine.constrain import (
+        _DFA_TEMPLATE_TABLE_BYTES, DFAGrammar, SchemaGrammar,
+    )
 
     tok = get_tokenizer()
     schema = {"type": "choice", "options": ["alpha variant one",
                                             "beta variant two"]}
     g = make_grammar(schema, tok)
-    assert isinstance(g, SchemaGrammar)
-    assert not hasattr(tok, "_dfa_tables_cache") or not any(
-        "alpha" in k for k in tok._dfa_tables_cache)
+    assert isinstance(g, DFAGrammar)
+
+    # oversized template: estimate (json chars x vocab x 5B) > budget
+    n = _DFA_TEMPLATE_TABLE_BYTES // (tok.vocab_size * 5) + 64
+    big = {"type": "choice", "options": ["x" * n, "y" * n]}
+    g_big = make_grammar(big, tok)
+    assert isinstance(g_big, SchemaGrammar)
     # after the first char narrows to one candidate, the span is forced
-    g.advance(tok.encode("a")[0])
-    c = g.constraint(100)
+    g_big.advance(tok.encode("x")[0])
+    c = g_big.constraint(4 * n)
     assert c.force is not None
 
 

@@ -272,7 +272,7 @@ def test_speculative_interpreted_grammar_host_fallback_exactness():
     template) cannot verify on device: the verify tick must take the host
     path (ship logits, per-position _greedy_with_grammar) and still equal
     the non-speculative run exactly."""
-    from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar, make_grammar
+    from k8s_llm_rca_tpu.engine.constrain import SchemaGrammar
 
     cfg = TINY
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -288,8 +288,11 @@ def test_speculative_interpreted_grammar_host_fallback_exactness():
                               prefill_buckets=(16,), max_new_tokens=64,
                               speculative_k=spec_k, decode_chunk=1),
             params, tok)
-        g = make_grammar(schema, tok)
-        assert isinstance(g, SchemaGrammar)       # interpreted, no tables
+        # built DIRECTLY as the interpreted FSM: make_grammar now
+        # DFA-compiles small templates, but the host-fallback verify path
+        # under test needs a grammar with no compiled tables
+        g = SchemaGrammar(schema, tok)
+        assert getattr(g, "tables", None) is None
         rid = eng.submit(prompt, max_new_tokens=64, grammar=g)
         res = {r.seq_id: r for r in eng.run_to_completion()}
         return res[rid].text
