@@ -194,18 +194,42 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
     """PP serving preconditions (shared by both engines).  Returns the
     resolved microbatch count (None when pp_mesh is None).
 
-    PP is currently exclusive with the other model-parallel axes: the
-    stage-sharded cache layout and the pipelined prefill/decode paths are
-    not TP/EP/CP-aware (composition is a mesh-layout problem the parity
-    tests don't yet cover — fail loudly instead of silently recomputing).
-    Speculative decoding is excluded too: decode_multi has no pipelined
-    equivalent, and _speculation_applies would silently never fire."""
+    PP composes with TP on ONE mesh carrying "stage" and "model" (the
+    multi-host pod topology: stages over DCN, heads/hidden over ICI; the
+    stage bodies run the manual-TP block with psum combines —
+    parallel/pipeline.py).  PP×TP serving requires full-precision KV and
+    unquantized weights (per-token quant scales span the FULL kv row /
+    the shard_map spec tree matches plain tensors).  CP/EP remain
+    exclusive, as does speculative decoding (decode_multi has no
+    pipelined equivalent, and _speculation_applies would silently never
+    fire)."""
     if pp_mesh is None:
         return None
-    for other, name in ((cp_mesh, "cp_mesh"), (ep_mesh, "ep_mesh"),
-                        (tp_mesh, "tp_mesh")):
+    for other, name in ((cp_mesh, "cp_mesh"), (ep_mesh, "ep_mesh")):
         if other is not None:
             raise ValueError(f"pp_mesh and {name} are mutually exclusive")
+    if tp_mesh is not None:
+        if tp_mesh is not pp_mesh:
+            raise ValueError(
+                "pp_mesh and tp_mesh must be the SAME composed mesh "
+                "(one Mesh carrying 'stage' and 'model'); two distinct "
+                "meshes cannot both lay out the weights and cache")
+        n_tp = tp_mesh.shape["model"]
+        if (model_cfg.n_heads % n_tp or model_cfg.n_kv_heads % n_tp):
+            raise ValueError(
+                f"n_heads={model_cfg.n_heads}/n_kv_heads="
+                f"{model_cfg.n_kv_heads} not divisible by model axis "
+                f"{n_tp} (required for PP×TP stage bodies)")
+        if engine_cfg.kv_cache_dtype is not None:
+            raise ValueError(
+                "PP×TP requires full-precision KV (per-token quant "
+                "scales are computed over the full kv row; per-shard "
+                "scales would diverge)")
+        if model_cfg.n_experts > 0:
+            raise ValueError(
+                "PP×TP does not support MoE models (the manual-TP stage "
+                "block computes a dense MLP; expert-stacked weights need "
+                "the EP dispatch, which PP excludes)")
     if stage_axis not in pp_mesh.shape:
         raise ValueError(f"pp_mesh needs a '{stage_axis}' axis, has "
                          f"{dict(pp_mesh.shape)}")
@@ -834,10 +858,13 @@ class InferenceEngine(EngineBase):
         modes already seq-shard activations their own way (exclusive)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
-        if sp and (tp_mesh is None or cp_mesh is not None):
+        if sp and (tp_mesh is None or cp_mesh is not None
+                   or pp_mesh is not None):
             raise ValueError("sp=True (Megatron sequence parallelism) "
-                             "requires tp_mesh and is exclusive with "
-                             "cp_mesh (CP already seq-shards activations)")
+                             "requires tp_mesh, is exclusive with cp_mesh "
+                             "(CP already seq-shards activations), and is "
+                             "unsupported on the PP paths (the pipelined "
+                             "prefill/decode do not thread sp_mesh)")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],
@@ -870,7 +897,22 @@ class InferenceEngine(EngineBase):
             model_cfg, b, engine_cfg.max_seq_len,
             kv_dtype={"int8": jnp.int8, "int4": "int4", None: None}[
                 engine_cfg.kv_cache_dtype])
-        if tp_mesh is not None and cp_mesh is not None:
+        if pp_mesh is not None and tp_mesh is not None:
+            # PP×TP composed serving: the cache's LAYER axis shards over
+            # "stage" AND its merged kv axis over "model" — each device
+            # holds its stage's layers × its TP shard's kv heads.  The
+            # spec comes from the pipeline module so the placement and
+            # the shard_map in/out specs cannot drift.
+            from k8s_llm_rca_tpu.parallel.pipeline import (
+                kv_cache_stage_specs,
+            )
+            from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
+
+            kv_spec = kv_cache_stage_specs("model", pp_stage_axis)
+            self.cache = shard_pytree(
+                self.cache,
+                llama.KVCache(kv_spec, kv_spec, None, None), pp_mesh)
+        elif tp_mesh is not None and cp_mesh is not None:
             # CP×TP composed serving (one mesh, validated above): the
             # cache takes the seq-major × head-minor layout — S over the
             # seq axis, the merged kv axis over "model", slots over
@@ -950,10 +992,23 @@ class InferenceEngine(EngineBase):
             # ARGUMENT (a closure would inline the weights as constants).
             from k8s_llm_rca_tpu.parallel import pipeline as pp
 
+            pp_tp_axis = "model" if tp_mesh is not None else None
+            if pp_tp_axis is not None:
+                from k8s_llm_rca_tpu.models.quant import (
+                    QuantTensor, QuantTensor4,
+                )
+
+                if any(isinstance(leaf, (QuantTensor, QuantTensor4))
+                       for leaf in jax.tree.leaves(
+                           params, is_leaf=lambda x: isinstance(
+                               x, (QuantTensor, QuantTensor4)))):
+                    raise ValueError(
+                        "PP×TP requires unquantized weights (the "
+                        "shard_map spec tree matches plain tensors)")
             n_stages = pp_mesh.shape[pp_stage_axis]
             stacked = pp.shard_stacked_layers(
                 pp.stack_llama_stages(params, n_stages), pp_mesh,
-                pp_stage_axis)
+                pp_stage_axis, cfg=model_cfg, tp_axis=pp_tp_axis)
             light = {k: v for k, v in params.items() if k != "layers"}
             self.params = (light, stacked)
             m = self._pp_m
@@ -962,13 +1017,13 @@ class InferenceEngine(EngineBase):
                 p, stk = params_t
                 return pp.llama_pp_prefill(cfg, p, cache, toks, lens,
                                            pp_mesh, m, pp_stage_axis, stk,
-                                           slots)
+                                           slots, tp_axis=pp_tp_axis)
 
             def pp_decode_fn(cfg, params_t, cache, toks, lens):
                 p, stk = params_t
                 return pp.llama_pp_decode_step(cfg, p, cache, toks, lens,
                                                pp_mesh, m, pp_stage_axis,
-                                               stk)
+                                               stk, tp_axis=pp_tp_axis)
 
             self._prefill = None        # PP admits through the batched path
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0)

@@ -659,10 +659,13 @@ class PagedInferenceEngine(EngineBase):
         context-parallel)."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
-        if sp and (tp_mesh is None or cp_mesh is not None):
+        if sp and (tp_mesh is None or cp_mesh is not None
+                   or pp_mesh is not None):
             raise ValueError("sp=True (Megatron sequence parallelism) "
-                             "requires tp_mesh and is exclusive with "
-                             "cp_mesh (CP already seq-shards activations)")
+                             "requires tp_mesh, is exclusive with cp_mesh "
+                             "(CP already seq-shards activations), and is "
+                             "unsupported on the PP paths (the pipelined "
+                             "prefill/decode do not thread sp_mesh)")
         from k8s_llm_rca_tpu.engine.engine import (
             params_multi_device, validate_ep_mesh, validate_pp_mesh,
             validate_tp_mesh,
@@ -675,6 +678,11 @@ class PagedInferenceEngine(EngineBase):
                                       cp_mesh, ep_mesh, tp_mesh,
                                       pp_microbatches, pp_stage_axis)
         self._pp = pp_mesh is not None
+        if self._pp and tp_mesh is not None:
+            raise ValueError(
+                "paged PP×TP is unsupported (the pool sharding and the "
+                "pipelined paged decode are stage-only); the contiguous "
+                "engine serves PP×TP")
         if self._pp:
             if engine_cfg.prefix_cache:
                 raise ValueError(

@@ -199,11 +199,15 @@ def _kv_packed(cfg: ModelConfig, cache: KVCache) -> bool:
 
 def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
          angles: jnp.ndarray, positions: jnp.ndarray):
-    """x [B, S, H] -> q [B, S, n_heads, d], k/v [B, S, n_kv, d] (roped q,k)."""
+    """x [B, S, H] -> q [B, S, n_heads, d], k/v [B, S, n_kv, d] (roped q,k).
+
+    Head counts derive from the projection widths (-1), not cfg, so the
+    same code serves manual-TP shard bodies whose local weights carry
+    n_heads/t heads (parallel/pipeline PP×TP)."""
     b, s, _ = x.shape
-    q = (x @ dq(layer["wq"])).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (x @ dq(layer["wk"])).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (x @ dq(layer["wv"])).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = (x @ dq(layer["wq"])).reshape(b, s, -1, cfg.head_dim)
+    k = (x @ dq(layer["wk"])).reshape(b, s, -1, cfg.head_dim)
+    v = (x @ dq(layer["wv"])).reshape(b, s, -1, cfg.head_dim)
     q = apply_rope(q, angles, positions)
     k = apply_rope(k, angles, positions)
     return q, k, v

@@ -91,12 +91,38 @@ def stack_llama_stages(params: Any, n_stages: int) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
 
 
+def stacked_layer_specs(cfg, stage_axis: str = "stage",
+                        tp_axis: str = None) -> Any:
+    """PartitionSpec tree for a ``stack_llama_stages`` tree: stage axis
+    leading; with ``tp_axis``, each leaf additionally takes its TP dim
+    from runtime.sharding.llama_param_specs shifted past the two stacking
+    dims — the PP×TP weight layout (stage over DCN, heads/hidden over
+    ICI)."""
+    from k8s_llm_rca_tpu.runtime.sharding import llama_param_specs
+
+    layer = llama_param_specs(cfg)["layers"][0]
+    if tp_axis is None:
+        return {k: P(stage_axis) for k in layer}
+    rename = {"model": tp_axis}
+    return {k: P(stage_axis, None,
+                 *(rename.get(a, a) for a in spec))
+            for k, spec in layer.items()}
+
+
 def shard_stacked_layers(stacked: Any, mesh: Mesh,
-                         stage_axis: str = "stage") -> Any:
+                         stage_axis: str = "stage", cfg=None,
+                         tp_axis: str = None) -> Any:
     """Place a ``stack_llama_stages`` tree with its leading stage axis
     sharded over ``mesh[stage_axis]`` — each device then holds ONLY its
     stage's layer weights, which is the HBM win that makes PP serve models
-    whose weights exceed one chip.  Serving engines hoist this once."""
+    whose weights exceed one chip.  Serving engines hoist this once.
+    With ``tp_axis`` (requires ``cfg``), leaves also shard their TP dims
+    (stacked_layer_specs) for PP×TP serving."""
+    if tp_axis is not None:
+        specs = stacked_layer_specs(cfg, stage_axis, tp_axis)
+        return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                for k, v in stacked.items()}
+
     def _put(x):
         spec = P(stage_axis, *(None,) * (x.ndim - 1))
         return jax.device_put(x, NamedSharding(mesh, spec))
@@ -199,9 +225,15 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 # compression that carries the big single-chip configs.
 
 
-def kv_cache_stage_specs() -> P:
-    """KVCache k/v [L, B, S, kv]: the LAYER axis shards over "stage"."""
-    return P("stage", None, None, None)
+def kv_cache_stage_specs(tp_axis: str = None,
+                         stage_axis: str = "stage") -> P:
+    """KVCache k/v [L, B, S, kv]: the LAYER axis shards over
+    ``stage_axis``; under PP×TP the kv axis additionally shards over
+    ``tp_axis``.  The ONE definition of the PP cache layout — the
+    engines place the cache with it and the shard_map in/out specs
+    reuse it, so the two cannot drift (a mismatch would silently
+    reshard the full cache every decode tick)."""
+    return P(stage_axis, None, None, tp_axis)
 
 
 def kv_scale_stage_specs() -> P:
@@ -218,8 +250,9 @@ def _kv_tuple(cache) -> Tuple:
     return (cache.k, cache.v)
 
 
-def _kv_specs(quant: bool) -> Tuple:
-    specs = (kv_cache_stage_specs(), kv_cache_stage_specs())
+def _kv_specs(quant: bool, tp_axis: str = None) -> Tuple:
+    kv = kv_cache_stage_specs(tp_axis)
+    specs = (kv, kv)
     if quant:
         specs += (kv_scale_stage_specs(), kv_scale_stage_specs())
     return specs
@@ -231,9 +264,47 @@ def _rebuild(cache, kv_out: Tuple):
     return type(cache)(kv_out[0], kv_out[1], None, None)
 
 
+def _block_prefill_tp(cfg, layer, x, angles, positions, seq_lens,
+                      tp_axis: str):
+    """Manual-TP transformer block for use INSIDE a shard_map stage body
+    (the PP×TP composition): column-parallel qkv / gate / up consume the
+    replicated residual stream and produce LOCAL head / hidden shards,
+    row-parallel wo / w_down produce partial sums combined with ``psum``
+    over ``tp_axis``.  Numerically matches ``llama._block_prefill`` (the
+    psum realizes the same contraction XLA's GSPMD inserts on the jitted
+    path); returns (x, k_local, v_local) with k/v carrying this shard's
+    kv heads only — the stage cache's kv axis is sharded to match."""
+    from k8s_llm_rca_tpu.models.llama import _qkv, dq, rms_norm
+    from k8s_llm_rca_tpu.ops.attention import causal_attention
+
+    b, s, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(cfg, layer, h, angles, positions)   # local head shards
+    attn = causal_attention(q, k, v, seq_lens)
+    out = attn.reshape(b, s, -1) @ dq(layer["wo"])
+    x = x + jax.lax.psum(out, tp_axis)
+    hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(hm @ dq(layer["w_gate"]))
+    up = hm @ dq(layer["w_up"])
+    x = x + jax.lax.psum((gate * up) @ dq(layer["w_down"]), tp_axis)
+    return x, k, v
+
+
+def _decode_finish_tp(cfg, layer, x, attn_flat, tp_axis: str):
+    """Decode-block back half under manual TP: row-parallel wo / w_down
+    partial sums psum-combined (mirrors ``llama._decode_finish``)."""
+    from k8s_llm_rca_tpu.models.llama import dq, rms_norm
+
+    x = x + jax.lax.psum(attn_flat @ dq(layer["wo"]), tp_axis)
+    hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(hm @ dq(layer["w_gate"]))
+    up = hm @ dq(layer["w_up"])
+    return x + jax.lax.psum((gate * up) @ dq(layer["w_down"]), tp_axis)
+
+
 def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
                      microbatches: int = None, stage_axis: str = "stage",
-                     stacked_layers=None, slots=None):
+                     stacked_layers=None, slots=None, tp_axis: str = None):
     """Pipeline-parallel batched prefill with per-stage KV writes.
 
     tokens [B, S_pad] right-padded, lengths [B]; B divides into
@@ -243,6 +314,12 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
     last real row, making the duplicate scatter writes idempotent).
     Returns (cache', logits [B, V] at each row's last valid token),
     matching ``llama.prefill_batch``.  Supports quantized caches.
+
+    ``tp_axis``: the PP×TP composition — stage bodies run the manual-TP
+    block (_block_prefill_tp: local head/hidden shards, psum combines)
+    with weights sharded (stage, tp) and the cache's kv axis sharded
+    over ``tp_axis``.  Full-precision KV only (per-token quant scales
+    are computed over the FULL kv row; per-shard scales would diverge).
     """
     from k8s_llm_rca_tpu.models import llama as L
 
@@ -255,6 +332,7 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
     stacked = (stacked_layers if stacked_layers is not None
                else stack_llama_stages(params, n_stages))
     quant = cache.quantized
+    assert not (quant and tp_axis), "PP×TP requires full-precision KV"
     packed = quant and L._kv_packed(cfg, cache)
 
     x = L.gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
@@ -276,10 +354,15 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
 
             def body(carry, xs):
                 layer, k_li, v_li = xs[0], xs[1], xs[2]
-                h2, k, v = L._block_prefill(cfg, layer, carry, angles,
-                                            positions, seq_lens)
-                k_new = k.reshape(bm, s_pad, cfg.kv_dim)
-                v_new = v.reshape(bm, s_pad, cfg.kv_dim)
+                if tp_axis is not None:
+                    h2, k, v = _block_prefill_tp(cfg, layer, carry, angles,
+                                                 positions, seq_lens,
+                                                 tp_axis)
+                else:
+                    h2, k, v = L._block_prefill(cfg, layer, carry, angles,
+                                                positions, seq_lens)
+                k_new = k.reshape(bm, s_pad, -1)     # kv_dim (or the local
+                v_new = v.reshape(bm, s_pad, -1)     # TP shard of it)
                 if quant:
                     ks_li, vs_li = xs[3], xs[4]
                     k_new, ks = L._quantize_kv(k_new, packed)
@@ -304,11 +387,13 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
+                    if tp_axis is not None else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis), P(*(None,) * 4),
                   P(None, None), P(None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis)),
         check_vma=False,
     )(stacked, _kv_tuple(cache), x_mb, lengths_mb, slots_mb)
 
@@ -320,7 +405,8 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
 
 def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                          microbatches: int = None,
-                         stage_axis: str = "stage", stacked_layers=None):
+                         stage_axis: str = "stage", stacked_layers=None,
+                         tp_axis: str = None):
     """One pipeline-parallel decode step for ALL slots.
 
     tokens [B] current token per slot, lengths [B] cached tokens; the B
@@ -346,8 +432,8 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                else stack_llama_stages(params, n_stages))
     s_max = cache.max_seq_len
     quant = cache.quantized
+    assert not (quant and tp_axis), "PP×TP requires full-precision KV"
     packed = quant and L._kv_packed(cfg, cache)
-    kv_last = cache.k.shape[-1]                  # kv_dim (or kv_dim/2 packed)
 
     x = L.gather_rows(params["embedding"],
                       tokens[:, None]).astype(jnp.dtype(cfg.dtype))  # [B,1,H]
@@ -369,8 +455,10 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                 # shared decode block halves (models/llama._decode_qkv /
                 # _decode_finish) keep PP token-for-token with decode_step
                 q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
-                k_tok = k[:, 0].reshape(bm, cfg.kv_dim)
-                v_tok = v[:, 0].reshape(bm, cfg.kv_dim)
+                k_tok = k[:, 0].reshape(bm, -1)   # kv_dim (or TP shard)
+                v_tok = v[:, 0].reshape(bm, -1)
+                kv_last = k_li.shape[-1]          # LOCAL kv width (PP×TP
+                # shards the cache's kv axis; packed int4 halves it)
                 orig_k = jax.lax.dynamic_slice(
                     k_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
                 orig_v = jax.lax.dynamic_slice(
@@ -394,12 +482,16 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                 attn = decode_attention(
                     q,
                     L._dequant_layer(k_rows, ks_rows, dtype, packed).reshape(
-                        bm, s_max, cfg.n_kv_heads, cfg.head_dim),
+                        bm, s_max, -1, cfg.head_dim),
                     L._dequant_layer(v_rows, vs_rows, dtype, packed).reshape(
-                        bm, s_max, cfg.n_kv_heads, cfg.head_dim),
+                        bm, s_max, -1, cfg.head_dim),
                     lens + 1)
-                hx = L._decode_finish(
-                    cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
+                if tp_axis is not None:
+                    hx = _decode_finish_tp(cfg, layer, carry,
+                                           attn.reshape(bm, 1, -1), tp_axis)
+                else:
+                    hx = L._decode_finish(
+                        cfg, layer, carry, attn.reshape(bm, 1, -1))
                 # garbage-tick masking at ROW granularity: only this
                 # microbatch's bm rows move, not the whole cache slice
                 k_li = jax.lax.dynamic_update_slice(
@@ -424,11 +516,13 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
+                    if tp_axis is not None else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis), P(*(None,) * 4),
                   P(None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis)),
         check_vma=False,
     )(stacked, _kv_tuple(cache), x_mb, lengths_mb)
 

@@ -2,6 +2,8 @@
 path must match its single-device reference implementation exactly
 (tolerance = fp32 accumulation noise)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -1250,6 +1252,81 @@ def test_pp_engine_dfa_scan_parity(cpu_devices):
     jsonlib.loads(outs[1])
 
 
+def test_pp_tp_composed_engine_matches_plain(cpu_devices):
+    """PP×TP in ONE mesh (the multi-host pod topology: stages over DCN,
+    heads/hidden over ICI): weights shard (stage, model), the cache
+    shards layer-over-stage × kv-over-model, stage bodies run the
+    manual-TP block with psum combines — exact greedy parity with the
+    plain engine, through prefill, decode and the chunked scan."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(n_layers=4, max_seq_len=64)
+    mesh = build_mesh(MeshConfig(stage=2, model=2),
+                      devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod crashloop kube-system", add_bos=True),
+               tok.encode("node disk pressure taint", add_bos=True)]
+    for chunk in (1, 4):
+        ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                            prefill_buckets=(16, 32), max_new_tokens=6,
+                            temperature=0.0, decode_chunk=chunk)
+        with jax.default_matmul_precision("float32"):
+            ref = make_engine(cfg, ecfg, params, tok).generate(
+                prompts, max_new_tokens=6)
+            eng = make_engine(cfg, ecfg, params, tok, pp_mesh=mesh,
+                              tp_mesh=mesh)
+            got = eng.generate(prompts, max_new_tokens=6)
+        for r, g in zip(ref, got):
+            assert r.token_ids == g.token_ids, chunk
+    # the cache is genuinely sharded on BOTH axes
+    shard = eng.cache.k.sharding.shard_shape(eng.cache.k.shape)
+    assert shard[0] == cfg.n_layers // 2           # layers over 'stage'
+    assert shard[3] == cfg.kv_dim // 2             # kv over 'model'
+
+
+def test_pp_tp_exclusions(cpu_devices):
+    """PP×TP rejects loudly: distinct meshes, quantized KV, quantized
+    weights, and the paged engine."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.models.quant import quantize_params
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(n_layers=4, max_seq_len=64)
+    mesh = build_mesh(MeshConfig(stage=2, model=2),
+                      devices=cpu_devices[:4])
+    mesh_b = build_mesh(MeshConfig(stage=2, model=2),
+                        devices=cpu_devices[4:8])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, prefill_buckets=(16,))
+    with pytest.raises(ValueError, match="SAME composed mesh"):
+        make_engine(cfg, ecfg, params, tok, pp_mesh=mesh, tp_mesh=mesh_b)
+    with pytest.raises(ValueError, match="full-precision KV"):
+        make_engine(cfg, dataclasses.replace(ecfg, kv_cache_dtype="int8"), params, tok,
+                    pp_mesh=mesh, tp_mesh=mesh)
+    with pytest.raises(ValueError, match="unquantized weights"):
+        make_engine(cfg, ecfg, quantize_params(params, bits=8), tok,
+                    pp_mesh=mesh, tp_mesh=mesh)
+    with pytest.raises(ValueError, match="paged PP×TP"):
+        make_engine(cfg, dataclasses.replace(ecfg, paged=True, page_size=16,
+                                        num_pages=16,
+                                        prefix_cache=False),
+                    params, tok, pp_mesh=mesh, tp_mesh=mesh,
+                    use_kernel=False)
+    with pytest.raises(ValueError, match="MoE"):
+        moe_cfg = TINY_MOE.replace(n_layers=4, n_experts=4, max_seq_len=64)
+        make_engine(moe_cfg, ecfg,
+                    llama.init_params(moe_cfg, jax.random.PRNGKey(1)),
+                    tok, pp_mesh=mesh, tp_mesh=mesh)
+    with pytest.raises(ValueError, match="unsupported on the PP paths"):
+        make_engine(cfg, ecfg, params, tok, pp_mesh=mesh, tp_mesh=mesh,
+                    sp=True)
+
+
 def test_pp_mesh_validation(cpu_devices):
     """PP preconditions fail loudly at construction, not mid-serve."""
     from k8s_llm_rca_tpu.config import EngineConfig
@@ -1265,7 +1342,8 @@ def test_pp_mesh_validation(cpu_devices):
     base = dict(max_batch=4, max_seq_len=64, prefill_buckets=(16, 32),
                 max_new_tokens=4)
 
-    with pytest.raises(ValueError, match="mutually exclusive"):
+    with pytest.raises(ValueError, match="SAME composed mesh"):
+        # PP×TP composes only on ONE mesh; two distinct meshes reject
         make_engine(cfg, EngineConfig(**base), params, tok,
                     pp_mesh=pp, tp_mesh=tp)
     from jax.sharding import Mesh as _Mesh
