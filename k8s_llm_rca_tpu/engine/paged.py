@@ -547,9 +547,11 @@ def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
                       use_kernel: Optional[bool] = None, ep_mesh=None,
                       decode_fn=None):
     """``n_steps`` paged decode steps with zero host sync (the paged
-    engine's chunked tick).  Valid only while no sequence crosses a page
-    boundary — the caller bounds ``n_steps`` by each slot's distance to
-    its next boundary so ``block_tables`` stays static for the whole scan.
+    engine's chunked tick).  ``block_tables`` stays static for the whole
+    scan; each per-step write indexes it dynamically (lengths // page),
+    so the scan may cross page boundaries into pages the caller
+    PRE-ALLOCATED for the window — the caller bounds ``n_steps`` by each
+    slot's contiguous allocated run (engine._chunk_bound).
 
     Returns (pool', tokens [n_steps, B], lengths').  Slots
     that hit ``eos_id`` stop advancing (token repeats; host trims).
@@ -933,7 +935,16 @@ class PagedInferenceEngine(EngineBase):
         if not self._active:
             return finished
 
-        # grow block tables for sequences about to cross a page boundary
+        # grow block tables to cover this tick's scan window: the
+        # per-step KV write indexes the table dynamically (lengths //
+        # page via take_along_axis), so pages pre-allocated for
+        # positions lengths..lengths+decode_chunk-1 let a chunked scan
+        # CROSS page boundaries while the table stays static.  The page
+        # holding position `lengths` is MANDATORY (a slot that cannot
+        # take one step preempts, as before); lookahead pages are
+        # best-effort — under pool pressure the slot's chunk bound just
+        # shrinks to its allocated run (_chunk_bound).
+        chunk_goal = max(1, self.engine_cfg.decode_chunk)
         for slot in sorted(self._active):
             if slot not in self._active:
                 # a previous iteration's _preempt_youngest() evicted it
@@ -947,6 +958,21 @@ class PagedInferenceEngine(EngineBase):
                         self._preempt_slot(slot)
                     else:
                         self._grow(slot)
+            if slot not in self._active or chunk_goal == 1:
+                continue
+            st = self._active[slot]
+            pos = int(self.lengths[slot])
+            last = min(pos + chunk_goal - 1,
+                       self.pages_per_seq * self.page_size - 1)
+            for idx in range(pos // self.page_size + 1,
+                             last // self.page_size + 1):
+                if self.block_tables[slot, idx] != TRASH_PAGE:
+                    continue
+                try:
+                    (page,) = self.allocator.alloc(1, owner=st.seq_id)
+                except OutOfPages:
+                    break              # best-effort: bound shrinks instead
+                self.block_tables[slot, idx] = page
         active_slots = sorted(self._active)
         if not active_slots:
             return finished
@@ -1027,11 +1053,19 @@ class PagedInferenceEngine(EngineBase):
     # ------------------------------------------------- chunked scan tick
 
     def _chunk_bound(self, slot: int) -> int:
-        # paged-only bound: no slot may cross a page boundary mid-scan
-        # (the block tables must stay static for the whole scan); growth
-        # already ran this tick, so the current page has
-        # page_size - (lengths % page_size) free positions
-        return self.page_size - int(self.lengths[slot]) % self.page_size
+        # paged-only bound: the scan may cross page boundaries into
+        # PRE-ALLOCATED pages (the per-step write indexes the block
+        # table dynamically; step()'s growth pass allocates the scan
+        # window ahead), so the bound is the slot's contiguous
+        # allocated run from its current position — with lookahead
+        # growth this is >= decode_chunk except under pool pressure,
+        # where it shrinks instead of collapsing the whole batch
+        pos = int(self.lengths[slot])
+        idx = pos // self.page_size
+        while (idx < self.pages_per_seq
+               and self.block_tables[slot, idx] != TRASH_PAGE):
+            idx += 1
+        return idx * self.page_size - pos
 
     def _scan_tick(self, chunk: int, active_slots) -> List[SequenceResult]:
         """Commit ``chunk`` paged decode steps from one on-device scan;

@@ -575,6 +575,30 @@ class TestCompiledDFA:
             eng.allocator.check()
         assert outs[1] == outs[8]
 
+    def test_paged_scan_crosses_page_boundaries(self):
+        """decode_chunk larger than page_size: the growth pass
+        pre-allocates the scan window, the chunk crosses page boundaries
+        inside one dispatch, and output is greedy-identical to stepwise
+        (allocator invariants intact)."""
+        outs = {}
+        tok = get_tokenizer()
+        cfg = TINY.replace(max_seq_len=256)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        for chunk in (1, 16):
+            ecfg = EngineConfig(max_batch=2, max_seq_len=256, paged=True,
+                                page_size=4, num_pages=140,
+                                prefill_buckets=(32,), max_new_tokens=48,
+                                temperature=0.0, decode_chunk=chunk)
+            eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                       use_kernel=False)
+            ids = [eng.submit(tok.encode(p, add_bos=True),
+                              max_new_tokens=48)
+                   for p in ("free one", "free two")]
+            res = {r.seq_id: r for r in eng.run_to_completion()}
+            outs[chunk] = [res[i].token_ids for i in ids]
+            eng.allocator.check()
+        assert outs[1] == outs[16]
+
     def test_schema_string_escapes(self):
         """Opt-in escape pairs in schema strings: quoted kubectl/JSON
         content is expressible where the field declares escapes=True,
