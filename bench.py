@@ -1,9 +1,11 @@
 """Benchmark entry point: prints ONE JSON line
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
-Headline metric: decode throughput (tokens/sec/chip) of the flagship model
-under batched continuous decoding on the local accelerator, using the
-on-device ``decode_scan`` loop (zero host sync inside the measured region).
+Headline metric: MEASURED decode throughput (tokens/sec/chip) — the
+flagship model's on-device ``decode_scan`` loop when its MFU cross-check
+holds, else the 100-incident engine sweep's tokens-over-wall-clock (see
+``main`` for the publication policy; ``value_source`` on the line says
+which measurement the headline is).
 
 ``vs_baseline``: the reference serves every LLM call through the OpenAI
 Assistants API behind a polling loop with a hard >=5 s first-poll floor
@@ -142,12 +144,14 @@ def bench_decode(cfg, batch, prompt_len, decode_steps, quant_bits=0):
     prefill_tps = batch * prompt_len / t_pref
     # prefill FLOPs/token ~= decode FLOPs at the mean causal context S/2
     pre_mfu = profiling.mfu(cfg, prefill_tps, prompt_len // 2)
+    pre_roof = profiling.roofline_prefill_tps(cfg, prompt_len)
 
     decode_tps, decode_mfu, decode_roof = _timed_decode_scan(
         cfg, params, cache, batch, prompt_len, decode_steps, tok.eos_id,
         weight_bits=quant_bits or 16, kv_bits=quant_bits or 16)
     return (decode_tps, decode_mfu, decode_roof, prefill_tps,
-            round(pre_mfu, 4) if pre_mfu is not None else None)
+            round(pre_mfu, 4) if pre_mfu is not None else None,
+            round(pre_roof, 2) if pre_roof is not None else None)
 
 
 def bench_8b():
@@ -268,14 +272,34 @@ def bench_rca_p50_engine(n_incidents: int = 100, workers: int = 16):
             with lock:
                 costs.append(cost)
 
+    # Measured decode throughput over the whole sweep: engine.decode_tokens
+    # counts every committed token across thousands of real, data-dependent
+    # ticks — dispatch-bound and memoization-immune, so tokens / host
+    # wall-clock is a believable MEASUREMENT (unlike the scan legs, whose
+    # wall-clock the tunnel's identical-execution memoization can break).
+    from k8s_llm_rca_tpu.runtime import profiling
+    from k8s_llm_rca_tpu.utils.logging import METRICS
+
+    tokens_before = METRICS.count("engine.decode_tokens")
+    t_start = time.perf_counter()
     threads = [threading.Thread(target=drain, daemon=True)
                for _ in range(workers)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    wall = time.perf_counter() - t_start
+    n_tokens = METRICS.count("engine.decode_tokens") - tokens_before
+    measured_tps = n_tokens / wall if wall > 0 else None
+    # mean KV context of RCA stage prompts (~1k tokens against the 4096
+    # cache); only feeds the MFU sanity cross-check on the tiny bench model
+    m = (profiling.mfu(cfg, measured_tps, 1024)
+         if measured_tps is not None else None)
     costs.sort()
-    return [costs[len(costs) // 2], len(costs), workers]
+    return [costs[len(costs) // 2], len(costs), workers,
+            round(measured_tps, 2) if measured_tps is not None else None,
+            round(m, 6) if m is not None else None, n_tokens,
+            round(wall, 2)]
 
 
 def _leg(expr: str, timeout: int = 560):
@@ -310,70 +334,99 @@ def _leg(expr: str, timeout: int = 560):
 def bench_decode_leg():
     """Subprocess entry: headline decode+prefill on the local chip."""
     cfg, batch, prompt_len, decode_steps, quant_bits = pick_config()
-    tps, mfu_d, roof, pre_tps, mfu_p = bench_decode(
+    tps, mfu_d, roof, pre_tps, mfu_p, pre_roof = bench_decode(
         cfg, batch, prompt_len, decode_steps, quant_bits)
     dev = jax.devices()[0]
-    return [tps, mfu_d, roof, pre_tps, mfu_p, cfg.name, batch, quant_bits,
-            str(dev), dev.platform]
+    return [tps, mfu_d, roof, pre_tps, mfu_p, pre_roof, cfg.name, batch,
+            quant_bits, str(dev), dev.platform]
 
 
 def main():
     """Host-only aggregator: every device leg runs in its own interpreter
-    (see _leg) so this process never takes the chip grant itself."""
+    (see _leg) so this process never takes the chip grant itself.
+
+    Publication policy (a named field never carries an unmeasured
+    number): each throughput field holds the raw MEASUREMENT, or null
+    when its own MFU cross-check proves the measurement physically
+    impossible (MFU > 1 — the tunnel's memoization/async timing broke
+    the wall clock, not the machine).  Discredited raw numbers move to
+    ``*_wall_clock_*`` fields with a ``*_suspect`` flag; the analytic
+    rooflines live ONLY in ``roofline_*`` fields.  The headline
+    ``value`` prefers the scan measurement when credible and otherwise
+    falls back to the engine-sweep measurement — tokens counted over
+    thousands of real data-dependent ticks, which memoization cannot
+    fake — so ``value`` is always a measured tokens/sec (value_source
+    says which) or null."""
     dec = _leg("bench.bench_decode_leg()")
     if dec is None:
-        dec = [None, None, None, None, None, "unknown", 0, 0, "unknown",
-               "none"]
+        dec = [None, None, None, None, None, None, "unknown", 0, 0,
+               "unknown", "none"]
     (decode_tps, mfu_decode, roof_decode, prefill_tps, mfu_prefill,
-     model_name, batch, quant_bits, device_str, platform) = dec
+     roof_prefill, model_name, batch, quant_bits, device_str,
+     platform) = dec
     p50_oracle = _leg("bench.bench_rca_p50()")
     # the real 100-incident sweep: budget scales with incident count and
     # the tunnel's per-tick dispatch cost (~0.25 s), amortized ~8x by the
     # worker overlap; 30 min covers compile + the sweep with margin
     eng = _leg("bench.bench_rca_p50_engine()", timeout=1800)
-    p50_engine, n_engine, n_workers = eng if eng else (None, None, None)
+    (p50_engine, n_engine, n_workers, eng_tps, eng_mfu, eng_tokens,
+     eng_wall) = eng if eng else (None,) * 7
     tps_8b = mfu_8b = roof_8b = None
     if platform == "tpu":
         res = _leg("list(bench.bench_8b())")
         if res is not None:
             tps_8b, mfu_8b, roof_8b = round(res[0], 2), res[1], res[2]
 
-    # self-audit + roofline cap: a wall-clock number above the hardware
-    # roofline (min of bf16-peak compute and HBM-bandwidth ceilings,
-    # runtime/profiling.roofline_decode_tps) is physically impossible —
-    # the axon tunnel's memoization/async timing broke the measurement.
-    # In that case the ROOFLINE is the defensible claim: publish it as
-    # the headline, keep the raw wall-clock number on the line, and say
-    # so.  MFU > 1.0 without a roofline (CPU) still flags suspect.
-    def cap(tps, roof):
-        if tps and roof and tps > roof:
-            return roof, True
-        return tps, False
+    def credible(tps, u, roof):
+        """A measurement is publishable under its own name unless a
+        cross-check proves it impossible: MFU > 1 (above the bf16 compute
+        peak) or above the full roofline (min of compute and HBM-bandwidth
+        ceilings — decode is usually bandwidth-bound, so the roofline
+        check binds well before MFU does).  Missing checks (CPU) pass."""
+        return (tps is not None and (u is None or u <= 1.0)
+                and (roof is None or tps <= roof))
 
-    claimed_tps, capped = cap(decode_tps, roof_decode)
-    claimed_8b, capped_8b = cap(tps_8b, roof_8b)
-    mfus = [u for u in (mfu_decode, mfu_prefill, mfu_8b) if u is not None]
-    suspect = any(u > 1.0 for u in mfus)
+    scan_ok = credible(decode_tps, mfu_decode, roof_decode)
+    pre_ok = credible(prefill_tps, mfu_prefill, roof_prefill)
+    ok_8b = credible(tps_8b, mfu_8b, roof_8b)
+    if scan_ok:
+        value, value_source = decode_tps, "decode_scan"
+    elif eng_tps is not None:
+        value, value_source = eng_tps, "engine_sweep_measured"
+    else:
+        value, value_source = None, None
 
     line = {
         "metric": "decode_throughput",
-        "value": round(claimed_tps, 2) if claimed_tps else None,
+        "value": round(value, 2) if value else None,
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(claimed_tps / REFERENCE_TOKENS_PER_S, 2)
-        if claimed_tps else None,
+        "vs_baseline": round(value / REFERENCE_TOKENS_PER_S, 2)
+        if value else None,
+        "value_source": value_source,
         "model": model_name,
         "weights": f"int{quant_bits}" if quant_bits else "bf16",
         "kv_cache": "int4" if quant_bits == 4
                     else "int8" if quant_bits else "bf16",
         "batch": batch,
+        # scan-leg decode: measurement-or-null + roofline in its own field
+        "scan_tokens_per_s": round(decode_tps, 2)
+        if scan_ok and decode_tps else None,
         "mfu": mfu_decode,
         "roofline_tokens_per_s": roof_decode,
-        "prefill_tokens_per_s": round(prefill_tps, 2) if prefill_tps
-        else None,
+        # prefill: same policy
+        "prefill_tokens_per_s": round(prefill_tps, 2)
+        if pre_ok and prefill_tps else None,
         "prefill_mfu": mfu_prefill,
-        "tokens_per_s_8b_int4": claimed_8b,
+        "roofline_prefill_tokens_per_s": roof_prefill,
+        # 8B leg: same policy
+        "tokens_per_s_8b_int4": tps_8b if ok_8b else None,
         "mfu_8b": mfu_8b,
         "roofline_tokens_per_s_8b": roof_8b,
+        # engine sweep: the always-credible measured tok/s (beside p50)
+        "engine_measured_tokens_per_s": eng_tps,
+        "engine_measured_mfu": eng_mfu,
+        "engine_decode_tokens": eng_tokens,
+        "engine_sweep_wall_s": eng_wall,
         "rca_p50_oracle_s": round(p50_oracle, 4)
         if p50_oracle is not None else None,
         "rca_p50_engine_s": round(p50_engine, 4)
@@ -382,14 +435,15 @@ def main():
         "rca_engine_workers": n_workers,
         "device": device_str,
     }
-    if capped:
-        line["roofline_capped"] = True
-        line["wall_clock_tokens_per_s"] = round(decode_tps, 2)
-    if capped_8b:
-        line["roofline_capped_8b"] = True
+    if decode_tps and not scan_ok:
+        line["scan_suspect"] = True
+        line["scan_wall_clock_tokens_per_s"] = round(decode_tps, 2)
+    if prefill_tps and not pre_ok:
+        line["prefill_suspect"] = True
+        line["prefill_wall_clock_tokens_per_s"] = round(prefill_tps, 2)
+    if tps_8b and not ok_8b:
+        line["suspect_8b"] = True
         line["wall_clock_tokens_per_s_8b"] = tps_8b
-    if suspect:
-        line["measurement_suspect"] = True
     print(json.dumps(line))
 
 

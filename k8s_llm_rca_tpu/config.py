@@ -197,6 +197,13 @@ class EngineConfig:
     seed: int = 0
     # decode loop
     decode_chunk: int = 16             # device steps per host sync in scan mode
+    # tick stepwise while requests are queued, so a freed slot is noticed
+    # within ONE decode step (prompt admission, lower TTFT under load).
+    # Off by default: on dispatch-latency-dominated hosts (the tunnel),
+    # draining the queue with per-token ticks costs more wall-clock than a
+    # request waiting out the current chunk.  Turn on for directly-attached
+    # chips where per-dispatch latency is negligible.
+    prompt_admission: bool = False
     # n-gram speculative decoding (greedy only; engine/speculative.py):
     # k drafts verified per tick by one multi-token decode.  0 = off.
     speculative_k: int = 0
@@ -243,6 +250,12 @@ class RCAConfig:
     # max_seq_len is a real KV budget, long sweeps need re-anchoring.
     # Retry-with-feedback WITHIN an incident still accumulates.
     fresh_threads: bool = False
+    # grammar-constrained decode for the three structured stages (plan
+    # schema, cypher skeleton, report schema).  False = raw free decode:
+    # output validity then rests entirely on the MODEL — the content-
+    # validation mode for distilled checkpoints (rca/distill.py), and the
+    # reference's own hope-and-retry regime (test_all.py:63-83)
+    constrained: bool = True
 
 
 @dataclass(frozen=True)

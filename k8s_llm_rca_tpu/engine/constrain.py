@@ -1029,6 +1029,24 @@ class SchemaGrammar:
                     f"schema grammar at {self.auto.stack[-1:]!r}")
 
 
+def _template_text_len(node) -> int:
+    """Estimated DFA state count for a choice/seq template grammar: the
+    automaton has ~one state per emittable literal character, so sum the
+    literal text lengths (choice options, seq items).  Non-literal
+    sub-nodes fall back to their serialized length (conservative)."""
+    if isinstance(node, str):
+        return len(node)
+    if isinstance(node, dict):
+        t = node.get("type")
+        if t == "choice":
+            return sum(_template_text_len(o) for o in node.get("options", ()))
+        if t == "seq":
+            return sum(_template_text_len(i) for i in node.get("items", ()))
+    import json as _json
+
+    return len(_json.dumps(node, default=str))
+
+
 def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
     """GenOptions.grammar -> FSM instance (None = unconstrained).
 
@@ -1051,9 +1069,12 @@ def make_grammar(name, tokenizer: Tokenizer, prefer_native: bool = True):
             # skeleton was in flight).  Compile when the estimated table
             # (one state per template char x vocab) stays small; fall back
             # to the interpreted FSM above that or on compile refusal.
-            import json as _json
-
-            est_states = len(_json.dumps(name, default=str))
+            # The estimate sums the template's LITERAL text lengths — the
+            # DFA has roughly one state per emittable char; counting the
+            # serialized dict's keys/syntax (len(json.dumps)) overshot ~2x
+            # and flipped mid-size templates to the interpreted FSM, which
+            # degrades the whole shared batch to per-token host ticks.
+            est_states = _template_text_len(name)
             if est_states * tokenizer.vocab_size * 5 <= \
                     _DFA_TEMPLATE_TABLE_BYTES:
                 try:

@@ -190,19 +190,22 @@ def validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh,
 
 def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
                      tp_mesh, microbatches: Optional[int],
-                     stage_axis: str = "stage") -> Optional[int]:
+                     stage_axis: str = "stage",
+                     params=None) -> Optional[int]:
     """PP serving preconditions (shared by both engines).  Returns the
     resolved microbatch count (None when pp_mesh is None).
 
     PP composes with TP on ONE mesh carrying "stage" and "model" (the
     multi-host pod topology: stages over DCN, heads/hidden over ICI; the
     stage bodies run the manual-TP block with psum combines —
-    parallel/pipeline.py).  PP×TP serving requires full-precision KV and
-    unquantized weights (per-token quant scales span the FULL kv row /
-    the shard_map spec tree matches plain tensors).  CP/EP remain
-    exclusive, as does speculative decoding (decode_multi has no
-    pipelined equivalent, and _speculation_applies would silently never
-    fire)."""
+    parallel/pipeline.py).  Quantized KV composes with PP×TP on both
+    engines: the per-token scale is the full-row scale recovered by pmax
+    over the TP group (llama._quantize_kv axis_name), so scale caches
+    replicate across TP and numerics match the plain quantized paths
+    exactly.  PP×TP still requires unquantized WEIGHTS (the shard_map
+    spec tree matches plain tensors).  CP/EP remain exclusive, as does
+    speculative decoding (decode_multi has no pipelined equivalent, and
+    _speculation_applies would silently never fire)."""
     if pp_mesh is None:
         return None
     for other, name in ((cp_mesh, "cp_mesh"), (ep_mesh, "ep_mesh")):
@@ -220,11 +223,16 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
                 f"n_heads={model_cfg.n_heads}/n_kv_heads="
                 f"{model_cfg.n_kv_heads} not divisible by model axis "
                 f"{n_tp} (required for PP×TP stage bodies)")
-        if engine_cfg.kv_cache_dtype is not None:
-            raise ValueError(
-                "PP×TP requires full-precision KV (per-token quant "
-                "scales are computed over the full kv row; per-shard "
-                "scales would diverge)")
+        if params is not None:
+            from k8s_llm_rca_tpu.models.quant import QuantTensor, QuantTensor4
+
+            if any(isinstance(leaf, (QuantTensor, QuantTensor4))
+                   for leaf in jax.tree.leaves(
+                       params, is_leaf=lambda x: isinstance(
+                           x, (QuantTensor, QuantTensor4)))):
+                raise ValueError(
+                    "PP×TP requires unquantized weights (the shard_map "
+                    "spec tree matches plain tensors)")
         if model_cfg.n_experts > 0:
             raise ValueError(
                 "PP×TP does not support MoE models (the manual-TP stage "
@@ -545,11 +553,14 @@ class EngineBase:
         The scan path amortizes per-dispatch host latency over many
         steps; only an interpreted (non-DFA) grammar forces stepwise
         ticks (it needs per-token host masks).  Mixed DFA grammars fuse
-        into one scan state space (_scan_dfa_setup), and queued
-        admissions do NOT force stepwise: admission happens at the next
-        step() either way, so draining the queue with per-token ticks
-        would only add dispatches (pathological on dispatch-latency-
-        dominated hosts).  The chunk is the largest power of two <=
+        into one scan state space (_scan_dfa_setup).  Queued admissions
+        force stepwise ticks only when ``prompt_admission`` is set:
+        admission happens at the next step() either way, so by default
+        draining the queue with per-token ticks would only add dispatches
+        (pathological on dispatch-latency-dominated hosts), but on
+        directly-attached chips the knob trades those cheap dispatches
+        for up to decode_chunk-1 steps of TTFT.  The chunk is the
+        largest power of two <=
         decode_chunk that fits every slot's CACHE headroom and subclass
         bound; per-slot token budgets deliberately do NOT bound it (DFA
         slots force-close in-scan, plain slots' over-decoded tokens are
@@ -559,6 +570,10 @@ class EngineBase:
         limit = self.engine_cfg.decode_chunk
         if limit <= 1:
             return 1
+        if self.engine_cfg.prompt_admission and self._pending:
+            return 1       # admit promptly: a retirement frees a slot within
+            # one step instead of up to decode_chunk-1 steps (config knob —
+            # low-dispatch-latency hosts only)
         for slot, st in self._active.items():
             if st.grammar is not None:
                 t = getattr(st.grammar, "tables", None)
@@ -876,7 +891,8 @@ class InferenceEngine(EngineBase):
                          cp_seq_axis)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
                                       cp_mesh, ep_mesh, tp_mesh,
-                                      pp_microbatches, pp_stage_axis)
+                                      pp_microbatches, pp_stage_axis,
+                                      params=params)
         self._pp = pp_mesh is not None
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
@@ -902,16 +918,20 @@ class InferenceEngine(EngineBase):
             # "stage" AND its merged kv axis over "model" — each device
             # holds its stage's layers × its TP shard's kv heads.  The
             # spec comes from the pipeline module so the placement and
-            # the shard_map in/out specs cannot drift.
+            # the shard_map in/out specs cannot drift.  Quantized scale
+            # caches shard layer-over-stage and REPLICATE across model
+            # (every TP shard writes the identical pmax full-row scale).
             from k8s_llm_rca_tpu.parallel.pipeline import (
-                kv_cache_stage_specs,
+                kv_cache_stage_specs, kv_scale_stage_specs,
             )
             from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
 
             kv_spec = kv_cache_stage_specs("model", pp_stage_axis)
+            sc_spec = (kv_scale_stage_specs(pp_stage_axis) if self.cache.quantized
+                       else None)
             self.cache = shard_pytree(
                 self.cache,
-                llama.KVCache(kv_spec, kv_spec, None, None), pp_mesh)
+                llama.KVCache(kv_spec, kv_spec, sc_spec, sc_spec), pp_mesh)
         elif tp_mesh is not None and cp_mesh is not None:
             # CP×TP composed serving (one mesh, validated above): the
             # cache takes the seq-major × head-minor layout — S over the
@@ -970,7 +990,7 @@ class InferenceEngine(EngineBase):
             from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
 
             kv_spec = kv_cache_stage_specs()
-            sc_spec = kv_scale_stage_specs()
+            sc_spec = kv_scale_stage_specs(pp_stage_axis)
             self.cache = shard_pytree(
                 self.cache,
                 llama.KVCache(kv_spec, kv_spec, sc_spec, sc_spec), pp_mesh)
@@ -993,18 +1013,6 @@ class InferenceEngine(EngineBase):
             from k8s_llm_rca_tpu.parallel import pipeline as pp
 
             pp_tp_axis = "model" if tp_mesh is not None else None
-            if pp_tp_axis is not None:
-                from k8s_llm_rca_tpu.models.quant import (
-                    QuantTensor, QuantTensor4,
-                )
-
-                if any(isinstance(leaf, (QuantTensor, QuantTensor4))
-                       for leaf in jax.tree.leaves(
-                           params, is_leaf=lambda x: isinstance(
-                               x, (QuantTensor, QuantTensor4)))):
-                    raise ValueError(
-                        "PP×TP requires unquantized weights (the "
-                        "shard_map spec tree matches plain tensors)")
             n_stages = pp_mesh.shape[pp_stage_axis]
             stacked = pp.shard_stacked_layers(
                 pp.stack_llama_stages(params, n_stages), pp_mesh,

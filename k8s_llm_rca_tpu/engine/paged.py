@@ -143,6 +143,83 @@ class PageAllocator:
                 f"leaked pages: {sorted(universe - free - owned)}")
 
 
+class PartitionedPageAllocator(PageAllocator):
+    """Page allocator whose id space splits into ``n_parts`` CONTIGUOUS
+    partitions — the host-side twin of a pool whose page axis is sharded
+    over the CP seq mesh axis (partition p's pages physically live on CP
+    device p).  ``alloc`` targets one partition (a page covering sequence
+    positions [j*page, (j+1)*page) must come from the device owning that
+    position range, engine._page_part); ``free``/``transfer`` return each
+    page to the partition its id falls in.  Invariants (no double free,
+    single owner, exact leak accounting) are PageAllocator's.
+    """
+
+    def __init__(self, n_pages: int, n_parts: int):
+        if n_pages % n_parts:
+            raise ValueError(
+                f"num_pages={n_pages} not divisible into {n_parts} "
+                f"partitions (pool page axis must shard evenly)")
+        super().__init__(n_pages)
+        self.n_parts = n_parts
+        per = n_pages // n_parts
+        # partition 0 loses page 0 (the reserved trash page)
+        self._free_parts: List[List[int]] = [
+            list(range(max(1, i * per), (i + 1) * per))
+            for i in range(n_parts)
+        ]
+        self._free = []          # base free list unused; see properties
+
+    def part_of(self, page: int) -> int:
+        return page * self.n_parts // self.n_pages
+
+    @property
+    def n_free(self) -> int:
+        return sum(len(p) for p in self._free_parts)
+
+    def alloc(self, n: int, owner: int, part: int = 0) -> List[int]:
+        free = self._free_parts[part]
+        if n > len(free):
+            raise OutOfPages(
+                f"need {n} pages in partition {part}, {len(free)} free "
+                f"(pool total free {self.n_free}/{self.n_pages})")
+        pages = [free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        return pages
+
+    def free(self, pages: Sequence[int], owner: int) -> None:
+        for p in pages:
+            if p == TRASH_PAGE:
+                raise AllocatorError("attempt to free the trash page")
+            got = self._owner.get(p)
+            if got is None:
+                raise AllocatorError(f"double free of page {p}")
+            if got != owner:
+                raise AllocatorError(
+                    f"page {p} owned by {got}, freed by {owner}")
+            del self._owner[p]
+            self._free_parts[self.part_of(p)].append(p)
+
+    def check(self) -> None:
+        free: Set[int] = set()
+        for i, part in enumerate(self._free_parts):
+            if len(set(part)) != len(part):
+                raise AllocatorError(f"duplicate entries in partition {i}")
+            for p in part:
+                if self.part_of(p) != i:
+                    raise AllocatorError(
+                        f"page {p} in wrong partition {i} "
+                        f"(belongs to {self.part_of(p)})")
+            free |= set(part)
+        owned: Set[int] = set(self._owner)
+        if free & owned:
+            raise AllocatorError(f"pages both free and owned: {free & owned}")
+        universe = set(range(1, self.n_pages))
+        if free | owned != universe:
+            raise AllocatorError(
+                f"leaked pages: {sorted(universe - free - owned)}")
+
+
 def make_allocator(n_pages: int, prefer_native: bool = True):
     """Page allocator factory: the C++ allocator (native/) when buildable,
     else the Python one — identical interface and invariants."""
@@ -655,8 +732,14 @@ class PagedInferenceEngine(EngineBase):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         runs context-parallel over it (ring or Ulysses, as in the
         contiguous engine) and scatters the full-depth KV into pool pages.
-        Requires page-rounded buckets divisible by the axis size, disables
-        batched admission (prefill_kv_cp is per-sequence) and is mutually
+        With axis size P > 1 the pool's PAGE axis is sharded over the
+        axis and allocation is partition-aligned (PartitionedPageAllocator:
+        a sequence's page j comes from the device owning positions
+        [j*page, (j+1)*page)), so each device stores 1/P of a long
+        context's paged KV — the same memory win as the contiguous CP
+        cache.  Requires page-rounded buckets divisible by the axis size
+        plus pages_per_seq and num_pages divisible by P, disables batched
+        admission (prefill_kv_cp is per-sequence) and is mutually
         exclusive with the prefix cache (the chunked prefix prefill is not
         context-parallel)."""
         if cp_mode not in ("ring", "ulysses"):
@@ -678,13 +761,9 @@ class PagedInferenceEngine(EngineBase):
                          cp_seq_axis)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
                                       cp_mesh, ep_mesh, tp_mesh,
-                                      pp_microbatches, pp_stage_axis)
+                                      pp_microbatches, pp_stage_axis,
+                                      params=params)
         self._pp = pp_mesh is not None
-        if self._pp and tp_mesh is not None:
-            raise ValueError(
-                "paged PP×TP is unsupported (the pool sharding and the "
-                "pipelined paged decode are stage-only); the contiguous "
-                "engine serves PP×TP")
         if self._pp:
             if engine_cfg.prefix_cache:
                 raise ValueError(
@@ -704,6 +783,7 @@ class PagedInferenceEngine(EngineBase):
         if use_kernel is None and (tp_mesh is not None
                                    or params_multi_device(params)):
             use_kernel = False
+        self._cp_parts = 0
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
                 raise ValueError(
@@ -715,6 +795,26 @@ class PagedInferenceEngine(EngineBase):
                 [-(-s // page) * page           # page-rounded, as _bucket does
                  for s in tuple(engine_cfg.prefill_buckets)
                  + (engine_cfg.max_seq_len,)])
+            n_cp = cp_mesh.shape[cp_seq_axis]
+            if n_cp > 1:
+                # seq-sharded pool: each CP device owns the page RANGE
+                # covering its sequence shard, so long-context paged
+                # serving stores 1/P of the KV bytes per device — the
+                # memory win the contiguous CP cache already has
+                pages_per_seq = -(-engine_cfg.max_seq_len
+                                  // engine_cfg.page_size)
+                if pages_per_seq % n_cp:
+                    raise ValueError(
+                        f"max_seq_len={engine_cfg.max_seq_len} spans "
+                        f"{pages_per_seq} pages, not divisible into "
+                        f"{n_cp} CP partitions (page-aligned CP splits "
+                        f"need pages_per_seq % n_cp == 0)")
+                if engine_cfg.num_pages % n_cp:
+                    raise ValueError(
+                        f"num_pages={engine_cfg.num_pages} not divisible "
+                        f"by the CP axis {n_cp} (the pool page axis "
+                        f"shards evenly)")
+                self._cp_parts = n_cp
         self._batch_admission = cp_mesh is None
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
@@ -751,7 +851,45 @@ class PagedInferenceEngine(EngineBase):
         self.pool = init_paged_cache(
             model_cfg, engine_cfg.num_pages, self.page_size,
             kv_dtype=engine_cfg.kv_cache_dtype)
-        if tp_mesh is not None:
+        if self._cp_parts:
+            # CP seq-sharded pool: the PAGE axis shards over the seq mesh
+            # axis — device p holds pages [p*N/P, (p+1)*N/P), exactly the
+            # range the partitioned allocator draws from for sequence
+            # positions [p*S/P, (p+1)*S/P) (page-aligned CP splits); with
+            # CP×TP the merged kv axis additionally shards over "model".
+            # Scale pools shard their page axis the same way.
+            from jax.sharding import PartitionSpec as _P
+
+            from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
+
+            cp_kv_spec = _P(None, cp_seq_axis, None,
+                            "model" if tp_mesh is not None else None)
+            cp_scale_spec = _P(None, cp_seq_axis, None)
+            self.pool = shard_pytree(
+                self.pool,
+                PagePool(cp_kv_spec, cp_kv_spec, cp_scale_spec,
+                         cp_scale_spec),
+                cp_mesh)
+        elif pp_mesh is not None and tp_mesh is not None:
+            # paged PP×TP: the pool's LAYER axis shards over "stage" AND
+            # its merged kv axis over "model" — each device holds its
+            # stage's layers × its TP shard of every page (the realistic
+            # multi-host serving shape: paged KV, stages over DCN, TP
+            # over ICI).  Scale pools shard layer-over-stage and
+            # replicate across model (every TP shard writes the identical
+            # pmax full-row scale — llama._quantize_kv axis_name).
+            from k8s_llm_rca_tpu.parallel.pipeline import (
+                kv_cache_stage_specs, kv_scale_stage_specs,
+            )
+            from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
+
+            kv_spec = kv_cache_stage_specs("model", pp_stage_axis)
+            self.pool = shard_pytree(
+                self.pool,
+                PagePool(kv_spec, kv_spec, kv_scale_stage_specs(pp_stage_axis),
+                         kv_scale_stage_specs(pp_stage_axis)),
+                pp_mesh)
+        elif tp_mesh is not None:
             # pool pages sharded on the merged kv axis over "model": each
             # device stores 1/P of every page's bytes (the paged analog of
             # kv_cache_specs); tiny per-token scale pools replicate
@@ -777,10 +915,17 @@ class PagedInferenceEngine(EngineBase):
             self.pool = shard_pytree(
                 self.pool,
                 PagePool(kv_cache_stage_specs(), kv_cache_stage_specs(),
-                         kv_scale_stage_specs(), kv_scale_stage_specs()),
+                         kv_scale_stage_specs(pp_stage_axis), kv_scale_stage_specs(pp_stage_axis)),
                 pp_mesh)
-        self.allocator = make_allocator(engine_cfg.num_pages,
-                                        engine_cfg.native)
+        if self._cp_parts:
+            # partition-aware allocation has no C++ twin (the native
+            # allocator is partition-blind); the Python partitioned
+            # allocator keeps identical invariants
+            self.allocator = PartitionedPageAllocator(engine_cfg.num_pages,
+                                                      self._cp_parts)
+        else:
+            self.allocator = make_allocator(engine_cfg.num_pages,
+                                            engine_cfg.native)
         self.prefix_cache = (PrefixCache(self.allocator, self.page_size)
                              if engine_cfg.prefix_cache else None)
 
@@ -810,10 +955,11 @@ class PagedInferenceEngine(EngineBase):
             # (a closure would inline the weights as constants)
             from k8s_llm_rca_tpu.parallel import pipeline as pp
 
+            pp_tp_axis = "model" if tp_mesh is not None else None
             n_stages = pp_mesh.shape[pp_stage_axis]
             stacked = pp.shard_stacked_layers(
                 pp.stack_llama_stages(params, n_stages), pp_mesh,
-                pp_stage_axis)
+                pp_stage_axis, cfg=model_cfg, tp_axis=pp_tp_axis)
             self.params = ({k: v for k, v in params.items()
                             if k != "layers"}, stacked)
             m = self._pp_m
@@ -821,14 +967,15 @@ class PagedInferenceEngine(EngineBase):
             def _pp_prefill_batch(cfg, params_t, pool, toks, lens, maps):
                 p, stk = params_t
                 return pp.paged_pp_prefill(cfg, p, pool, toks, lens, maps,
-                                           pp_mesh, m, pp_stage_axis, stk)
+                                           pp_mesh, m, pp_stage_axis, stk,
+                                           tp_axis=pp_tp_axis)
 
             def pp_decode_fn(cfg, params_t, pool, toks, lens, bt,
                              use_kernel=None):
                 p, stk = params_t
                 return pp.paged_pp_decode_step(cfg, p, pool, toks, lens, bt,
                                                pp_mesh, m, pp_stage_axis,
-                                               stk)
+                                               stk, tp_axis=pp_tp_axis)
 
             self._prefill = None     # PP admits through the batched path
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0,
@@ -944,35 +1091,54 @@ class PagedInferenceEngine(EngineBase):
         # take one step preempts, as before); lookahead pages are
         # best-effort — under pool pressure the slot's chunk bound just
         # shrinks to its allocated run (_chunk_bound).
+        # Two passes: every slot's MANDATORY page first, then best-effort
+        # lookahead across slots.  Interleaving them let an earlier slot's
+        # scan-window lookahead drain the pool and push a later slot's
+        # mandatory grow into preempt_youngest — avoidable preemption churn
+        # under pool pressure.
         chunk_goal = max(1, self.engine_cfg.decode_chunk)
         for slot in sorted(self._active):
             if slot not in self._active:
                 # a previous iteration's _preempt_youngest() evicted it
                 continue
             if self.lengths[slot] % self.page_size == 0:
-                try:
-                    self._grow(slot)
-                except OutOfPages:
-                    if not self._preempt_youngest(exclude=slot):
-                        # evict this one instead (it cannot take a step)
-                        self._preempt_slot(slot)
-                    else:
+                # keep evicting youngest-first until the grow succeeds: one
+                # eviction is always enough for the plain pool, but under
+                # the CP seq-sharded pool the freed pages may fall in a
+                # DIFFERENT partition than the one this slot's next page
+                # must come from, so the retry can fail repeatedly
+                while slot in self._active:
+                    try:
                         self._grow(slot)
-            if slot not in self._active or chunk_goal == 1:
-                continue
-            st = self._active[slot]
-            pos = int(self.lengths[slot])
-            last = min(pos + chunk_goal - 1,
-                       self.pages_per_seq * self.page_size - 1)
-            for idx in range(pos // self.page_size + 1,
-                             last // self.page_size + 1):
-                if self.block_tables[slot, idx] != TRASH_PAGE:
-                    continue
-                try:
-                    (page,) = self.allocator.alloc(1, owner=st.seq_id)
-                except OutOfPages:
-                    break              # best-effort: bound shrinks instead
-                self.block_tables[slot, idx] = page
+                        break
+                    except OutOfPages:
+                        if not self._preempt_youngest(exclude=slot):
+                            # evict this one instead (it cannot take a step)
+                            self._preempt_slot(slot)
+                            break
+        if chunk_goal > 1:
+            for slot in sorted(self._active):
+                st = self._active[slot]
+                pos = int(self.lengths[slot])
+                last = min(pos + chunk_goal - 1,
+                           self.pages_per_seq * self.page_size - 1)
+                for idx in range(pos // self.page_size + 1,
+                                 last // self.page_size + 1):
+                    if self.block_tables[slot, idx] != TRASH_PAGE:
+                        continue
+                    try:
+                        # best-effort: plain alloc (never evicts prefix
+                        # pages), partition-aligned under the CP pool
+                        if self._cp_parts:
+                            (page,) = self.allocator.alloc(
+                                1, owner=st.seq_id,
+                                part=self._page_part(idx))
+                        else:
+                            (page,) = self.allocator.alloc(1,
+                                                           owner=st.seq_id)
+                    except OutOfPages:
+                        break          # best-effort: bound shrinks instead
+                    self.block_tables[slot, idx] = page
         active_slots = sorted(self._active)
         if not active_slots:
             return finished
@@ -1125,6 +1291,32 @@ class PagedInferenceEngine(EngineBase):
                 raise
             return self.allocator.alloc(n, owner=owner)
 
+    def _page_part(self, seq_page_idx: int) -> int:
+        """CP partition owning a sequence's page index: page j covers
+        positions [j*page, (j+1)*page), which live on CP device
+        j * P // pages_per_seq — the same contiguous position split the
+        contiguous CP cache uses."""
+        return seq_page_idx * self._cp_parts // self.pages_per_seq
+
+    def _alloc_seq_pages(self, seq_page_idxs, owner: int) -> List[int]:
+        """Allocate one page per sequence-page index.  Under the CP
+        seq-sharded pool each page comes from the partition owning that
+        index's position range (all-or-nothing: a partial failure frees
+        what was taken); otherwise one plain allocation."""
+        idxs = list(seq_page_idxs)
+        if not self._cp_parts:
+            return self._alloc_with_evict(len(idxs), owner=owner)
+        pages: List[int] = []
+        try:
+            for j in idxs:
+                pages.extend(self.allocator.alloc(
+                    1, owner=owner, part=self._page_part(j)))
+        except OutOfPages:
+            if pages:
+                self.allocator.free(pages, owner=owner)
+            raise
+        return pages
+
     def _admission_group(self) -> Tuple[List[_Pending], Tuple[List[int], int]]:
         """Peek (without popping) a FIFO run of same-bucket pending
         requests for one batched prefill, plus the head's prefix-cache
@@ -1179,7 +1371,10 @@ class PagedInferenceEngine(EngineBase):
         assert len(rest) <= bucket, (len(rest), bucket)
         n_pages = bucket // self.page_size
         try:
-            pages = self._alloc_with_evict(n_pages, owner=req.seq_id)
+            # sequence-page indices n_cp..n_cp+n_pages-1 (partition-aligned
+            # under the CP seq-sharded pool; plain allocation otherwise)
+            pages = self._alloc_seq_pages(range(n_cp, n_cp + n_pages),
+                                          owner=req.seq_id)
         except OutOfPages:
             if cached_pages:
                 self.prefix_cache.release(cached_pages)
@@ -1323,7 +1518,10 @@ class PagedInferenceEngine(EngineBase):
             return                              # at cap; finish_reason handles
         if self.block_tables[slot, idx] != TRASH_PAGE:
             return                              # page already present
-        (page,) = self._alloc_with_evict(1, owner=st.seq_id)
+        if self._cp_parts:
+            (page,) = self._alloc_seq_pages([idx], owner=st.seq_id)
+        else:
+            (page,) = self._alloc_with_evict(1, owner=st.seq_id)
         self.block_tables[slot, idx] = page
 
     def _preempt_youngest(self, exclude: Optional[int] = None) -> bool:

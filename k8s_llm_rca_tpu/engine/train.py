@@ -36,12 +36,15 @@ def next_token_loss(cfg: ModelConfig, params, tokens: jnp.ndarray,
 def make_train_step(cfg: ModelConfig,
                     optimizer: optax.GradientTransformation
                     ) -> Callable:
-    """Jittable (params, opt_state, tokens) -> (params, opt_state, loss).
-    Sharding comes from the argument placements (GSPMD propagation)."""
+    """Jittable (params, opt_state, tokens[, loss_mask]) ->
+    (params, opt_state, loss).  Sharding comes from the argument
+    placements (GSPMD propagation).  ``loss_mask`` [B, S] (optional)
+    restricts the CE to masked-in positions — supervised-completion
+    distillation trains only on the target tokens (rca/distill.py)."""
 
-    def train_step(params, opt_state, tokens):
+    def train_step(params, opt_state, tokens, loss_mask=None):
         loss, grads = jax.value_and_grad(
-            lambda p: next_token_loss(cfg, p, tokens))(params)
+            lambda p: next_token_loss(cfg, p, tokens, loss_mask))(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -326,15 +326,25 @@ def _decode_finish(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
     return x + _mlp(cfg, layer, hm, ep_mesh)
 
 
-def _quantize_kv(kv: jnp.ndarray, packed: bool = False
+def _quantize_kv(kv: jnp.ndarray, packed: bool = False,
+                 axis_name: Optional[str] = None
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-token int8 (or nibble-packed int4 when ``packed``): kv
     [..., kv_dim] -> (int8 [..., kv_dim] | packed int8 [..., kv_dim/2],
     scale [...]).  The scale stays a per-token SCALAR in both modes: any
     trailing group axis would lane-pad to 128 on TPU and eat the savings
-    (see KVCache docstring)."""
+    (see KVCache docstring).
+
+    ``axis_name``: inside a manual-TP shard_map body (parallel/pipeline
+    PP×TP) each shard holds only its slice of the kv row; pmax-ing the
+    local amax over the TP axis reproduces the FULL-row scale bit-for-bit,
+    so shards quantize their slices exactly as the unsharded path
+    quantizes the whole row — scale pools stay replicated across TP and
+    quantized PP×TP matches the plain engines token-for-token."""
     qmax = 7.0 if packed else 127.0
     amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
+    if axis_name is not None:
+        amax = jax.lax.pmax(amax, axis_name)
     scale = jnp.where(amax > 0, amax / qmax, 1.0)
     q = jnp.clip(jnp.round(kv.astype(jnp.float32) / scale[..., None]),
                  -qmax, qmax).astype(jnp.int8)

@@ -250,6 +250,40 @@ def encoder_params_from_hf(cfg: EncoderConfig,
     }
 
 
+def llama_params_to_hf(cfg: ModelConfig, params: Params
+                       ) -> Dict[str, np.ndarray]:
+    """Inverse of ``llama_params_from_hf`` (dense Llama): framework pytree
+    -> HF-named state dict.  Exports an IN-TREE-trained checkpoint (e.g. a
+    distilled RCA model, rca/distill.py) to the interchange format
+    ``load_llama`` reads, closing the train -> checkpoint -> load -> serve
+    loop without external weights."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("dense Llama export only")
+
+    def host(x):
+        return np.asarray(x, dtype=_np_dtype(cfg.dtype))
+
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": host(params["embedding"]),
+        "model.norm.weight": host(params["final_norm"]),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = host(params["lm_head"])
+    for i, layer in enumerate(params["layers"]):
+        p = f"model.layers.{i}."
+        out[p + "input_layernorm.weight"] = host(layer["attn_norm"])
+        out[p + "post_attention_layernorm.weight"] = host(layer["mlp_norm"])
+        out[p + "self_attn.q_proj.weight"] = host(layer["wq"]).T
+        out[p + "self_attn.k_proj.weight"] = host(layer["wk"]).T
+        out[p + "self_attn.v_proj.weight"] = host(layer["wv"]).T
+        out[p + "self_attn.o_proj.weight"] = host(layer["wo"]).T
+        out[p + "mlp.gate_proj.weight"] = host(layer["w_gate"]).T
+        out[p + "mlp.up_proj.weight"] = host(layer["w_up"]).T
+        out[p + "mlp.down_proj.weight"] = host(layer["w_down"]).T
+    # .T produces views; write_safetensors needs contiguous buffers
+    return {k: np.ascontiguousarray(v) for k, v in out.items()}
+
+
 def load_llama(cfg: ModelConfig, path: str) -> Params:
     """Load a Llama/Mixtral-family checkpoint file or dir."""
     return llama_params_from_hf(cfg, load_checkpoint_tensors(path))

@@ -236,10 +236,10 @@ def kv_cache_stage_specs(tp_axis: str = None,
     return P(stage_axis, None, None, tp_axis)
 
 
-def kv_scale_stage_specs() -> P:
+def kv_scale_stage_specs(stage_axis: str = "stage") -> P:
     """KVCache/PagePool scales [L, B, S] / [L, pages, page]: layer axis
-    over "stage", like the payload they scale."""
-    return P("stage", None, None)
+    over ``stage_axis``, like the payload they scale."""
+    return P(stage_axis, None, None)
 
 
 def _kv_tuple(cache) -> Tuple:
@@ -250,11 +250,13 @@ def _kv_tuple(cache) -> Tuple:
     return (cache.k, cache.v)
 
 
-def _kv_specs(quant: bool, tp_axis: str = None) -> Tuple:
-    kv = kv_cache_stage_specs(tp_axis)
+def _kv_specs(quant: bool, tp_axis: str = None,
+              stage_axis: str = "stage") -> Tuple:
+    kv = kv_cache_stage_specs(tp_axis, stage_axis)
     specs = (kv, kv)
     if quant:
-        specs += (kv_scale_stage_specs(), kv_scale_stage_specs())
+        specs += (kv_scale_stage_specs(stage_axis),
+                  kv_scale_stage_specs(stage_axis))
     return specs
 
 
@@ -318,8 +320,10 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
     ``tp_axis``: the PP×TP composition — stage bodies run the manual-TP
     block (_block_prefill_tp: local head/hidden shards, psum combines)
     with weights sharded (stage, tp) and the cache's kv axis sharded
-    over ``tp_axis``.  Full-precision KV only (per-token quant scales
-    are computed over the FULL kv row; per-shard scales would diverge).
+    over ``tp_axis``.  Quantized KV composes: the per-token scale is the
+    FULL-row scale recovered by pmax over the TP group
+    (llama._quantize_kv axis_name), so scale caches stay replicated
+    across TP and numerics match the unsharded quantized path exactly.
     """
     from k8s_llm_rca_tpu.models import llama as L
 
@@ -332,7 +336,6 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
     stacked = (stacked_layers if stacked_layers is not None
                else stack_llama_stages(params, n_stages))
     quant = cache.quantized
-    assert not (quant and tp_axis), "PP×TP requires full-precision KV"
     packed = quant and L._kv_packed(cfg, cache)
 
     x = L.gather_rows(params["embedding"], tokens).astype(jnp.dtype(cfg.dtype))
@@ -365,8 +368,8 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
                 v_new = v.reshape(bm, s_pad, -1)     # TP shard of it)
                 if quant:
                     ks_li, vs_li = xs[3], xs[4]
-                    k_new, ks = L._quantize_kv(k_new, packed)
-                    v_new, vs = L._quantize_kv(v_new, packed)
+                    k_new, ks = L._quantize_kv(k_new, packed, tp_axis)
+                    v_new, vs = L._quantize_kv(v_new, packed, tp_axis)
                     # row-granular garbage-tick masking, scales included
                     ks_li = ks_li.at[rows, :s_pad].set(
                         jnp.where(valid, ks, ks_li[rows, :s_pad]))
@@ -391,9 +394,9 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
                     if tp_axis is not None else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(stacked_spec, _kv_specs(quant, tp_axis), P(*(None,) * 4),
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
                   P(None, None), P(None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
         check_vma=False,
     )(stacked, _kv_tuple(cache), x_mb, lengths_mb, slots_mb)
 
@@ -432,7 +435,6 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                else stack_llama_stages(params, n_stages))
     s_max = cache.max_seq_len
     quant = cache.quantized
-    assert not (quant and tp_axis), "PP×TP requires full-precision KV"
     packed = quant and L._kv_packed(cfg, cache)
 
     x = L.gather_rows(params["embedding"],
@@ -465,8 +467,8 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                     v_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
                 if quant:
                     ks_li, vs_li = xs[3], xs[4]
-                    k_tok, ks1 = L._quantize_kv(k_tok, packed)
-                    v_tok, vs1 = L._quantize_kv(v_tok, packed)
+                    k_tok, ks1 = L._quantize_kv(k_tok, packed, tp_axis)
+                    v_tok, vs1 = L._quantize_kv(v_tok, packed, tp_axis)
                     orig_ks = jax.lax.dynamic_slice(
                         ks_li, (mb_idx * bm, 0), (bm, s_max))
                     orig_vs = jax.lax.dynamic_slice(
@@ -520,9 +522,9 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                     if tp_axis is not None else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(stacked_spec, _kv_specs(quant, tp_axis), P(*(None,) * 4),
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
                   P(None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
         check_vma=False,
     )(stacked, _kv_tuple(cache), x_mb, lengths_mb)
 
@@ -537,7 +539,8 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
 
 def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
                      mesh: Mesh, microbatches: int = None,
-                     stage_axis: str = "stage", stacked_layers=None):
+                     stage_axis: str = "stage", stacked_layers=None,
+                     tp_axis: str = None):
     """Pipeline-parallel paged prefill: N sequences' KV scattered into
     their pool pages, the pool's LAYER axis sharded over "stage".
 
@@ -546,6 +549,12 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
     engine/paged.paged_prefill_batch, incl. idempotent duplicate padding
     rows).  N must divide into ``microbatches``.  Returns (pool', logits
     [N, V] at each row's last valid token).  Supports quantized pools.
+
+    ``tp_axis``: paged PP×TP — stage bodies run the manual-TP block and
+    the pool's merged kv axis additionally shards over ``tp_axis`` (each
+    device holds its stage's layers × its TP shard of every page).
+    Quantized pools compose via the pmax full-row scale
+    (llama._quantize_kv axis_name); scale pools replicate across TP.
     """
     from k8s_llm_rca_tpu.models import llama as L
     from k8s_llm_rca_tpu.engine.paged import PagePool, _pool_packed
@@ -581,14 +590,20 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
 
             def body(carry, xs):
                 layer, k_li, v_li = xs[0], xs[1], xs[2]
-                h2, k, v = L._block_prefill(cfg, layer, carry, angles,
-                                            positions, seq_lens)
-                k_new = k.reshape(bm, s_pad, cfg.kv_dim)
-                v_new = v.reshape(bm, s_pad, cfg.kv_dim)
+                if tp_axis is not None:
+                    h2, k, v = _block_prefill_tp(cfg, layer, carry, angles,
+                                                 positions, seq_lens,
+                                                 tp_axis)
+                else:
+                    h2, k, v = L._block_prefill(cfg, layer, carry, angles,
+                                                positions, seq_lens)
+                # kv_dim, or the local TP shard of it
+                k_new = k.reshape(bm, s_pad, -1)
+                v_new = v.reshape(bm, s_pad, -1)
                 if quant:
                     ks_li, vs_li = xs[3], xs[4]
-                    k_new, ks = L._quantize_kv(k_new, packed)
-                    v_new, vs = L._quantize_kv(v_new, packed)
+                    k_new, ks = L._quantize_kv(k_new, packed, tp_axis)
+                    v_new, vs = L._quantize_kv(v_new, packed, tp_axis)
                     ks = ks.reshape(bm, n_seq_pages, page_size)
                     vs = vs.reshape(bm, n_seq_pages, page_size)
                     ks_li = ks_li.at[pages].set(
@@ -610,11 +625,13 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
+                    if tp_axis is not None else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
                   P(None, None), P(None, None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
         check_vma=False,
     )(stacked, _kv_tuple(pool), x_mb, lengths_mb, maps_mb)
 
@@ -626,7 +643,8 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
 
 def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
                          mesh: Mesh, microbatches: int = None,
-                         stage_axis: str = "stage", stacked_layers=None):
+                         stage_axis: str = "stage", stacked_layers=None,
+                         tp_axis: str = None):
     """One pipeline-parallel paged decode step for ALL slots.
 
     tokens [B]; lengths [B]; block_tables [B, pages_per_seq].  The new
@@ -635,6 +653,11 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
     pallas_call has no SPMD rule, and per-stage grids are small).  Returns
     (pool', logits [B, V]) matching ``paged.paged_decode_step``, incl.
     quantized pools.  Hot paths must pass a hoisted ``stacked_layers``.
+
+    ``tp_axis``: paged PP×TP — the stage body's qkv/attention run on the
+    local head shard (weights sharded (stage, tp), pool kv axis sharded
+    over ``tp_axis``) with psum combines in the block back half; quantized
+    pools use the pmax full-row scale, scale pools replicated across TP.
     """
     from k8s_llm_rca_tpu.models import llama as L
     from k8s_llm_rca_tpu.engine.paged import _pool_packed
@@ -677,13 +700,15 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
 
             def body(carry, xs):
                 layer, k_li, v_li = xs[0], xs[1], xs[2]
+                # _decode_qkv derives head counts from the projection
+                # widths, so local TP weight shards yield local heads
                 q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
-                k_tok = k[:, 0].reshape(bm, cfg.kv_dim)
-                v_tok = v[:, 0].reshape(bm, cfg.kv_dim)
+                k_tok = k[:, 0].reshape(bm, -1)   # kv_dim (or TP shard)
+                v_tok = v[:, 0].reshape(bm, -1)
                 if quant:
                     ks_li, vs_li = xs[3], xs[4]
-                    k_tok, ks1 = L._quantize_kv(k_tok, packed)
-                    v_tok, vs1 = L._quantize_kv(v_tok, packed)
+                    k_tok, ks1 = L._quantize_kv(k_tok, packed, tp_axis)
+                    v_tok, vs1 = L._quantize_kv(v_tok, packed, tp_axis)
                     ks_li = ks_li.at[page_ids, offsets].set(
                         jnp.where(valid, ks1, ks_li[page_ids, offsets]))
                     vs_li = vs_li.at[page_ids, offsets].set(
@@ -695,19 +720,22 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
                     jnp.where(valid, v_tok.astype(v_li.dtype),
                               v_li[page_ids, offsets]))
                 # gathered dense per-sequence view of the LOCAL layer slice
+                # (head count from the local width: kv_dim/t under PP×TP)
                 k_all = L._dequant_layer(
                     jnp.take(k_li, bt, axis=0),
                     jnp.take(ks_li, bt, axis=0) if quant else None,
-                    dtype, packed).reshape(bm, s_max, cfg.n_kv_heads,
-                                           cfg.head_dim)
+                    dtype, packed).reshape(bm, s_max, -1, cfg.head_dim)
                 v_all = L._dequant_layer(
                     jnp.take(v_li, bt, axis=0),
                     jnp.take(vs_li, bt, axis=0) if quant else None,
-                    dtype, packed).reshape(bm, s_max, cfg.n_kv_heads,
-                                           cfg.head_dim)
+                    dtype, packed).reshape(bm, s_max, -1, cfg.head_dim)
                 attn = decode_attention(q, k_all, v_all, lens + 1)
-                hx = L._decode_finish(
-                    cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
+                if tp_axis is not None:
+                    hx = _decode_finish_tp(cfg, layer, carry,
+                                           attn.reshape(bm, 1, -1), tp_axis)
+                else:
+                    hx = L._decode_finish(
+                        cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
                 return hx, ((k_li, v_li, ks_li, vs_li) if quant
                             else (k_li, v_li))
 
@@ -717,11 +745,13 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
+                    if tp_axis is not None else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(stage_axis), _kv_specs(quant), P(*(None,) * 4),
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
                   P(None, None), P(None, None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
         check_vma=False,
     )(stacked, _kv_tuple(pool), x_mb, lengths_mb, bt_mb)
 

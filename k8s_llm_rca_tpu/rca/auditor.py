@@ -82,7 +82,9 @@ def report_schema() -> dict:
 
 def setup_state_semantic_analyzer(service: AssistantService,
                                   model: str = "local",
-                                  max_new_tokens: int = 512) -> GenericAssistant:
+                                  max_new_tokens: int = 512,
+                                  constrained: bool = True
+                                  ) -> GenericAssistant:
     analyzer = GenericAssistant(service)
     analyzer.create_assistant(
         ANALYZER_INSTRUCTIONS, "k8s-state-semantic-analyzer", model,
@@ -90,12 +92,14 @@ def setup_state_semantic_analyzer(service: AssistantService,
     seed_analyzer_thread(analyzer)
     # the summary run uses a SEPARATE assistant whose decode is schema-
     # constrained to the report shape; it runs ON the analyzer's thread so
-    # it sees every audit exchange (the per-entity audits stay free text)
+    # it sees every audit exchange (the per-entity audits stay free text).
+    # constrained=False drops the schema: the report must parse on the
+    # model's own merits (distilled-checkpoint content validation)
     reporter = GenericAssistant(service)
     reporter.create_assistant(
         ANALYZER_INSTRUCTIONS, "k8s-rca-reporter", model,
         gen=GenOptions(max_new_tokens=max(max_new_tokens, 192),
-                       grammar=report_schema()))
+                       grammar=report_schema() if constrained else None))
     analyzer.reporter = reporter
     return analyzer
 

@@ -68,12 +68,15 @@ def plan_schema(kind_vocabulary: Sequence[str]) -> Dict[str, Any]:
 def setup_root_cause_locator(
         service: AssistantService, model: str = "local",
         max_new_tokens: int = 768,
-        kind_vocabulary: Optional[Sequence[str]] = None) -> GenericAssistant:
+        kind_vocabulary: Optional[Sequence[str]] = None,
+        constrained: bool = True) -> GenericAssistant:
     """``kind_vocabulary``: when given, decode is schema-constrained to the
     plan contract with kinds restricted to this vocabulary (structured
-    outputs); otherwise any-JSON grammar (the round-1 behavior)."""
-    grammar: Any = (plan_schema(kind_vocabulary) if kind_vocabulary
-                    else "json")
+    outputs); otherwise any-JSON grammar (the round-1 behavior).
+    ``constrained=False`` drops the grammar entirely — plan validity then
+    rests on the model (distilled-checkpoint content validation)."""
+    grammar: Any = ((plan_schema(kind_vocabulary) if kind_vocabulary
+                     else "json") if constrained else None)
     locator = GenericAssistant(service)
     locator.create_assistant(
         LOCATOR_INSTRUCTIONS, "k8s-root-cause-locator", model,

@@ -63,7 +63,7 @@ class OracleBackend:
         results: Dict[int, BackendResult] = {}
         for handle, (prompt, opts) in list(self._inflight.items()):
             del self._inflight[handle]
-            body = self._respond(prompt)
+            body = self._respond(prompt, opts.assistant_name)
             text = opts.forced_prefix + body + opts.suffix
             results[handle] = BackendResult(
                 text=text, completion_tokens=self.tokenizer.count(text))
@@ -86,14 +86,39 @@ class OracleBackend:
             return True
         return False
 
-    def _respond(self, prompt: str) -> str:
-        """Route on the NEWEST user message — the thread is shared across an
-        incident sweep (reference design, SURVEY §3.4), so anchoring on the
-        whole rendered prompt would replay earlier incidents' requests."""
+    def _respond(self, prompt: str, assistant_name: str = "") -> str:
+        """Route primarily on the assistant name the service attaches to the
+        run (GenOptions.assistant_name) — stable under prompt rewordings.
+        Within a stage, pick the NEWEST matching user message: the thread is
+        shared across an incident sweep (reference design, SURVEY §3.4) and
+        retry-with-feedback appends exception text as the newest message, so
+        the newest *request-shaped* message is the one to answer."""
         msgs = _user_messages(prompt)
         if not msgs:
             return "Understood."
         last = msgs[-1]
+        if assistant_name == "k8s-root-cause-locator":
+            # "predefined" distinguishes the real planning request from
+            # retry-feedback messages that merely quote the malformed output
+            for m in reversed(msgs):
+                if "DestinationKind" in m and "predefined" in m:
+                    return self._plan_dest_kind(m)
+            return "Understood."
+        if assistant_name == "cypher-query-generator":
+            for m in reversed(msgs):
+                if "the provided metapath is:" in m:
+                    return self._compile_cypher(m)
+            return "Understood."
+        if assistant_name == "k8s-rca-reporter":
+            return self._summarize(last, prompt)
+        if assistant_name == "k8s-state-semantic-analyzer":
+            if "The following JSON comes from a" in last:
+                return self._semantic_clue(last)
+            if "summarize" in last and "relevance score" in last.lower():
+                return self._summarize(last, prompt)
+            return "Understood."   # seeded rules / pushed clue evidence
+        # Fallback: legacy substring routing, for callers that drive the
+        # backend directly without the assistants service (no name attached).
         if "DestinationKind" in last and "predefined" in last:
             return self._plan_dest_kind(last)
         if "generation-template-1" in last and \

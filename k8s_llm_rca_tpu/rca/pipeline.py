@@ -50,7 +50,8 @@ class RCAPipeline:
         self.locator = locator.setup_root_cause_locator(
             self.service, self.cfg.model,
             max_new_tokens=self.cfg.locator_max_new_tokens,
-            kind_vocabulary=self.native_kinds + self.external_kinds)
+            kind_vocabulary=self.native_kinds + self.external_kinds,
+            constrained=self.cfg.constrained)
         self.prompt_template = locator.build_prompt_template(
             self.native_kinds, self.external_kinds)
         self.cypher_generator = cyphergen.setup_cypher_generator(
@@ -58,7 +59,8 @@ class RCAPipeline:
             max_new_tokens=self.cfg.cypher_max_new_tokens)
         self.analyzer = auditor.setup_state_semantic_analyzer(
             self.service, self.cfg.model,
-            max_new_tokens=self.cfg.analyzer_max_new_tokens)
+            max_new_tokens=self.cfg.analyzer_max_new_tokens,
+            constrained=self.cfg.constrained)
 
     def reset_threads(self) -> None:
         """Fresh stage threads with their seeds re-applied: bounds prompt
@@ -118,7 +120,8 @@ class RCAPipeline:
         for attempt in range(self.cfg.cypher_max_attempts):
             try:
                 cypher_query = cyphergen.generate_cypher_query(
-                    metapath_str, error_message, self.cypher_generator)
+                    metapath_str, error_message, self.cypher_generator,
+                    constrain=self.cfg.constrained)
                 records = cyphergen.run_and_filter_query(
                     self.state_executor, cypher_query)
                 generated_ok = True

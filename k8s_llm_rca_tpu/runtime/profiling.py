@@ -204,6 +204,21 @@ def roofline_decode_tps(cfg: ModelConfig, context_len: int, batch: int,
     return min(compute, memory)
 
 
+def roofline_prefill_tps(cfg: ModelConfig, prompt_len: int,
+                         device: Optional[Any] = None) -> Optional[float]:
+    """Hardware ceiling on prefill tokens/sec: the compute roofline (bf16
+    peak over FLOPs-per-token at the mean causal context prompt_len/2).
+    Prefill at bench batch·seq sizes is compute-bound — every weight byte
+    is amortized over thousands of tokens, so the memory leg sits far
+    above this one and the compute ceiling is the binding upper bound a
+    prefill tok/s claim must clear.  None off-TPU."""
+    dev = device or jax.devices()[0]
+    peak_tf = _longest_prefix(_PEAK_TFLOPS, getattr(dev, "device_kind", ""))
+    if peak_tf is None:
+        return None
+    return peak_tf * 1e12 / decode_flops_per_token(cfg, prompt_len // 2)
+
+
 @dataclass
 class StepTimer:
     """Rolling decode-step timing for sweeps: tokens/sec and per-phase p50

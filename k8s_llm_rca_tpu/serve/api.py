@@ -33,6 +33,7 @@ decodes every in-flight run, including the blocked worker's.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import threading
 import time
@@ -213,7 +214,8 @@ class AssistantService:
         self._thread_runs[thread_id].append(run.id)
 
         prompt = render_prompt(assistant, self.threads[thread_id], instructions)
-        opts = gen or assistant.gen
+        opts = dataclasses.replace(gen or assistant.gen,
+                                   assistant_name=assistant.name)
         run.usage["prompt_tokens"] = self.backend.count_tokens(prompt)
         run.backend_handle = self.backend.start(prompt, opts)
         run.status = RunStatus.IN_PROGRESS

@@ -38,6 +38,11 @@ class GenOptions:
     # (structured outputs).  Composes with forced_prefix / suffix carrying
     # the fences.  None = unconstrained.
     grammar: Optional[object] = None
+    # routing metadata: the name of the assistant the run belongs to,
+    # populated by AssistantService.create_run.  Engine backends ignore it;
+    # the scripted oracle routes on it (prompt-substring routing is brittle
+    # to harmless rewordings and kept only as its fallback).
+    assistant_name: str = ""
 
 
 class BudgetError(ValueError):

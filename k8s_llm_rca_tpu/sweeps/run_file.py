@@ -75,26 +75,41 @@ def load_corpus(path: str) -> list:
     return messages
 
 
-def completed_incidents(output_path: str) -> int:
-    """Resumability: count already-written records (the file is a stream of
-    concatenated pretty-printed JSON objects, reference format)."""
+def scan_output(output_path: str, truncate_partial: bool = False):
+    """Resumability scan: (completed records' error_messages, character
+    offset past the last COMPLETE record).  The file is a stream of
+    concatenated pretty-printed JSON objects (reference format); a crash
+    mid-append leaves a partial tail object, which the offset excludes —
+    ``truncate_partial`` rewrites the file without it (one read, in here,
+    so resume doesn't re-read the whole output just to truncate)."""
     if not os.path.exists(output_path):
-        return 0
+        return [], 0
     with open(output_path) as f:
         text = f.read()
     decoder = json.JSONDecoder()
-    idx, count = 0, 0
+    idx, msgs, end = 0, [], 0
     while idx < len(text):
         while idx < len(text) and text[idx].isspace():
             idx += 1
         if idx >= len(text):
             break
         try:
-            _, idx = decoder.raw_decode(text, idx)
+            obj, idx = decoder.raw_decode(text, idx)
         except ValueError:
             break                         # trailing partial record
-        count += 1
-    return count
+        msgs.append(obj.get("error_message"))
+        end = idx
+    if truncate_partial and len(text.rstrip()) > end:
+        log.warning("truncating partial tail record in %s (crash artifact)",
+                    output_path)
+        with open(output_path, "w") as f:
+            f.write(text[:end] + ("\n" if end else ""))
+    return msgs, end
+
+
+def completed_incidents(output_path: str) -> int:
+    """Count of complete records already in the output."""
+    return len(scan_output(output_path)[0])
 
 
 def main(argv=None) -> dict:
@@ -130,10 +145,27 @@ def main(argv=None) -> dict:
     messages = load_corpus(args.input)
     lo, hi = (int(x) if x else None for x in args.slice.split(":"))
     messages = messages[lo:hi]
-    skip = completed_incidents(args.output) if args.resume else 0
-    if skip:
-        log.info("resuming: %d incidents already in %s", skip, args.output)
-        messages = messages[skip:]
+    if args.resume:
+        # Resume matches completed records to input incidents by MESSAGE
+        # (multiset), not by count: under --workers/--replicas incidents
+        # complete out of input order, so "skip the first N" would both
+        # duplicate unfinished early incidents and drop finished late
+        # ones.  A crash mid-append can also leave a partial tail record;
+        # truncate it so the resumed appends keep the file parseable.
+        done_msgs, _ = scan_output(args.output, truncate_partial=True)
+        if done_msgs:
+            log.info("resuming: %d incidents already in %s",
+                     len(done_msgs), args.output)
+            from collections import Counter
+
+            done = Counter(done_msgs)
+            remaining = []
+            for m in messages:
+                if done[m] > 0:
+                    done[m] -= 1
+                else:
+                    remaining.append(m)
+            messages = remaining
 
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
     start = time.time()

@@ -1,0 +1,73 @@
+"""Multi-process distributed init: the DCN path, actually executed.
+
+SURVEY §2.2's collectives row and §5's distributed-backend row call for
+``jax.distributed.initialize``-based multi-host init (the reference has no
+distributed anything — its whole comm story is HTTPS + two bolt sockets,
+reference common/neo4j_query_executor.py:3-8).  Everything else multi-chip
+in this suite runs on ONE process with virtual devices; these tests spawn
+TWO separate processes that form a real cluster through
+``runtime.mesh.initialize_distributed`` (coordinator + worker over a local
+TCP port), build a global mesh spanning both processes' devices, and run
+one cross-process psum and one sharded train step (tests/_distributed_worker.py).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(pid: int, n_proc: int, port: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    # pin the platform in the ENVIRONMENT, not just inside the worker: a
+    # harness sitecustomize (e.g. an accelerator-tunnel site dir on
+    # PYTHONPATH) may pre-import jax and force its platform before the
+    # worker's own os.environ writes run (same trap
+    # __graft_entry__._respawn_clean documents), and a backend
+    # initialized on another platform ignores the distributed init —
+    # so replace PYTHONPATH with the repo root and pin cpu
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(_WORKER))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    return subprocess.Popen(
+        [sys.executable, _WORKER, str(pid), str(n_proc), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+def test_two_process_cluster_psum_and_train_step():
+    """Coordinator (process 0) + worker (process 1) form a cluster via
+    initialize_distributed; each asserts the global device view, runs a
+    cross-process psum and a DP×TP train step whose gradient reductions
+    cross the process boundary.  Both must exit 0 with matching losses."""
+    port = _free_port()
+    procs = [_spawn(i, 2, port) for i in range(2)]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=360)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"WORKER {i} OK" in out, out[-3000:]
+    # the jitted train step is one program over one global mesh: both
+    # processes must report the IDENTICAL loss
+    losses = [line.split("loss=")[1].split()[0]
+              for out in outs for line in out.splitlines()
+              if "loss=" in line]
+    assert len(losses) == 2 and losses[0] == losses[1], losses

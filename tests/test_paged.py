@@ -217,8 +217,14 @@ class TestPreemptionPolicy:
 
     def test_stop_string_spans_resume_boundary(self):
         """Stop strings split by a preemption must still terminate the
-        sequence: the match window sees pre-preemption tokens too."""
-        paged, _, tok, _ = self._engine()
+        sequence: the match window sees pre-preemption tokens too.
+
+        decode_chunk=1 pins step() to one generated token: the test pokes
+        engine internals between steps, and on hardware the default chunked
+        scan tick would decode the whole 8-token budget inside the first
+        step() and retire the sequence before we can simulate a preemption.
+        """
+        paged, _, tok, _ = self._engine(decode_chunk=1)
         seq = paged.submit(tok.encode("x", add_bos=True),
                            max_new_tokens=8, stop_strings=("```",))
         paged.step()                      # admit; one token generated

@@ -890,9 +890,10 @@ def test_cp_tp_composed_engine_quantized_cache(cpu_devices):
 
 
 def test_cp_tp_composed_paged_engine_matches_plain(cpu_devices):
-    """Paged CP×TP: TP-aware ring prefill scatters into the model-sharded
-    page pool; decode shards pages over 'model' via GSPMD — exact greedy
-    parity with the plain paged engine."""
+    """Paged CP×TP: TP-aware ring prefill scatters into the seq×model
+    sharded page pool (page axis over 'seq', merged kv over 'model');
+    decode composes via GSPMD — exact greedy parity with the plain paged
+    engine."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
     from k8s_llm_rca_tpu.runtime.sharding import (
@@ -922,6 +923,114 @@ def test_cp_tp_composed_paged_engine_matches_plain(cpu_devices):
     for r, g in zip(ref, got):
         assert r.token_ids == g.token_ids
     eng.allocator.check()
+    # the pool is sharded on BOTH axes: pages over 'seq', kv over 'model'
+    shard = eng.pool.k.sharding.shard_shape(eng.pool.k.shape)
+    assert shard[1] == ecfg.num_pages // 2
+    assert shard[3] == cfg.kv_dim // 2
+
+
+def test_cp_paged_seq_sharded_pool(cpu_devices):
+    """CP seq-sharded paged pool (page-aligned CP splits): each CP device
+    owns the page RANGE covering its sequence shard, so the paged engine
+    stores 1/P of a long context's KV per device — the memory win the
+    contiguous CP cache already had.  Greedy parity with the plain paged
+    engine through decode that GROWS across the partition boundary, plus
+    pool-bytes-per-device and allocator-partition assertions."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import (
+        PagedInferenceEngine, PartitionedPageAllocator, TRASH_PAGE,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=32)
+    mesh = build_mesh(MeshConfig(seq=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    # pages_per_seq = 4, partition boundary at page idx 2 (position 16):
+    # a 12-token prompt + 12 new tokens crosses into partition 1 mid-decode
+    ecfg = EngineConfig(max_batch=2, max_seq_len=32, page_size=8,
+                        num_pages=16, prefill_buckets=(16,),
+                        max_new_tokens=12, temperature=0.0,
+                        prefix_cache=False, paged=True, decode_chunk=1)
+    prompts = [tok.encode("0123456789a", add_bos=True),   # 12 tokens
+               tok.encode("pvc not bnd", add_bos=True)]
+    assert all(len(p) == 12 for p in prompts)
+
+    with jax.default_matmul_precision("float32"):
+        ref = PagedInferenceEngine(cfg, ecfg, params, tok).generate(
+            prompts, max_new_tokens=12)
+        eng = PagedInferenceEngine(cfg, ecfg, params, tok, cp_mesh=mesh)
+        # partition-aware allocation is active
+        assert isinstance(eng.allocator, PartitionedPageAllocator)
+        got = eng.generate(prompts, max_new_tokens=12)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+        # every sequence decoded past position 16 (the partition boundary)
+        assert r.prompt_tokens + r.completion_tokens > 16
+    eng.allocator.check()
+    assert eng.allocator.n_free == 15              # nothing leaked
+
+    # 1/P pool bytes per device: page axis sharded over 'seq'
+    shard = eng.pool.k.sharding.shard_shape(eng.pool.k.shape)
+    assert shard[1] == ecfg.num_pages // 2
+
+    # partition alignment invariant: after a fresh admission, the page
+    # covering positions [16, 24) must come from partition 1's id range
+    seq = eng.submit(tok.encode("0123456789a", add_bos=True),
+                     max_new_tokens=12)
+    for _ in range(40):
+        if not eng.has_work:
+            break
+        eng.step()
+        for slot, st in eng._active.items():
+            table = eng.block_tables[slot]
+            for j in range(eng.pages_per_seq):
+                if table[j] != TRASH_PAGE:
+                    assert eng.allocator.part_of(int(table[j])) == \
+                        eng._page_part(j), (j, int(table[j]))
+    eng.allocator.check()
+
+
+def test_cp_paged_partition_exhaustion_preempts_not_crashes(cpu_devices):
+    """CP seq-sharded pool under PARTITION pressure: when the partition a
+    growing slot needs is exhausted, evicting the youngest slot may free
+    pages only in OTHER partitions — step() must keep evicting (and
+    finally preempt the growing slot itself) instead of crashing on the
+    unsatisfied retry (regression: the single-retry grow assumed any
+    freed page could satisfy alloc, true only for the unpartitioned
+    pool)."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.logging import METRICS
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=32)
+    mesh = build_mesh(MeshConfig(seq=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=32, page_size=8,
+                        num_pages=16, prefill_buckets=(16,),
+                        max_new_tokens=12, temperature=0.0,
+                        prefix_cache=False, paged=True, decode_chunk=1)
+    eng = PagedInferenceEngine(cfg, ecfg, params, tok, cp_mesh=mesh)
+    # exhaust partition 1 (pages 8..15) so crossing position 16 cannot grow
+    stolen = eng.allocator.alloc(8, owner=999, part=1)
+    prompts = [tok.encode("0123456789a", add_bos=True) for _ in range(2)]
+    assert all(len(p) == 12 for p in prompts)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=12)
+    before = METRICS.count("engine.preemptions")
+    for _ in range(12):                      # churns, must not raise
+        if eng.has_work:
+            eng.step()
+    assert METRICS.count("engine.preemptions") > before
+    eng.allocator.check()
+    # free the hostage partition: the sweep completes normally
+    eng.allocator.free(stolen, owner=999)
+    results = eng.run_to_completion()
+    assert len(results) == 2
+    eng.allocator.check()
+    assert eng.allocator.n_free == 15
 
 
 def test_ep_tp_dp_composed_engine_matches_dense(cpu_devices):
@@ -1252,12 +1361,15 @@ def test_pp_engine_dfa_scan_parity(cpu_devices):
     jsonlib.loads(outs[1])
 
 
-def test_pp_tp_composed_engine_matches_plain(cpu_devices):
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "int4"])
+def test_pp_tp_composed_engine_matches_plain(cpu_devices, kv_dtype):
     """PP×TP in ONE mesh (the multi-host pod topology: stages over DCN,
     heads/hidden over ICI): weights shard (stage, model), the cache
     shards layer-over-stage × kv-over-model, stage bodies run the
     manual-TP block with psum combines — exact greedy parity with the
-    plain engine, through prefill, decode and the chunked scan."""
+    plain engine, through prefill, decode and the chunked scan.
+    Quantized KV composes: the pmax full-row scale makes int8/int4
+    PP×TP bit-identical to the plain quantized engine."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine import make_engine
     from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
@@ -1272,7 +1384,8 @@ def test_pp_tp_composed_engine_matches_plain(cpu_devices):
     for chunk in (1, 4):
         ecfg = EngineConfig(max_batch=2, max_seq_len=64,
                             prefill_buckets=(16, 32), max_new_tokens=6,
-                            temperature=0.0, decode_chunk=chunk)
+                            temperature=0.0, decode_chunk=chunk,
+                            kv_cache_dtype=kv_dtype)
         with jax.default_matmul_precision("float32"):
             ref = make_engine(cfg, ecfg, params, tok).generate(
                 prompts, max_new_tokens=6)
@@ -1280,16 +1393,68 @@ def test_pp_tp_composed_engine_matches_plain(cpu_devices):
                               tp_mesh=mesh)
             got = eng.generate(prompts, max_new_tokens=6)
         for r, g in zip(ref, got):
-            assert r.token_ids == g.token_ids, chunk
+            assert r.token_ids == g.token_ids, (kv_dtype, chunk)
     # the cache is genuinely sharded on BOTH axes
     shard = eng.cache.k.sharding.shard_shape(eng.cache.k.shape)
     assert shard[0] == cfg.n_layers // 2           # layers over 'stage'
-    assert shard[3] == cfg.kv_dim // 2             # kv over 'model'
+    assert shard[3] == eng.cache.k.shape[3] // 2   # kv over 'model'
+    if kv_dtype is not None:
+        # scale caches shard layer-over-stage, replicate across model
+        sc = eng.cache.k_scale.sharding.shard_shape(eng.cache.k_scale.shape)
+        assert sc[0] == cfg.n_layers // 2
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "int4"])
+def test_pp_tp_paged_engine_matches_plain(cpu_devices, kv_dtype):
+    """Paged PP×TP — the realistic multi-host pod serving shape (paged
+    KV + continuous batching, stages over DCN, TP over ICI): weights
+    shard (stage, model), the pool shards layer-over-stage ×
+    kv-over-model, stage bodies run manual-TP qkv/attention with psum
+    combines.  Quantized pools (int8 + packed int4) compose via the pmax
+    full-row scale, so greedy parity with the plain paged engine is
+    exact — through admission churn, page growth and the chunked scan."""
+    from k8s_llm_rca_tpu.config import EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    mesh = build_mesh(MeshConfig(stage=2, model=2),
+                      devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True),
+               tok.encode("oom killed container", add_bos=True),
+               tok.encode("node disk pressure taint", add_bos=True),
+               tok.encode("dns resolution failing", add_bos=True)]
+    for chunk in (1, 4):
+        ecfg = EngineConfig(max_batch=4, max_seq_len=64,
+                            prefill_buckets=(16, 32), max_new_tokens=6,
+                            temperature=0.0, kv_cache_dtype=kv_dtype,
+                            paged=True, page_size=16, num_pages=32,
+                            prefix_cache=False, decode_chunk=chunk)
+        with jax.default_matmul_precision("float32"):
+            ref = PagedInferenceEngine(cfg, ecfg, params, tok).generate(
+                prompts, max_new_tokens=6)
+            eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                       pp_mesh=mesh, tp_mesh=mesh)
+            got = eng.generate(prompts, max_new_tokens=6)
+        for r, g in zip(ref, got):
+            assert r.token_ids == g.token_ids, (kv_dtype, chunk)
+        eng.allocator.check()                  # no pages leaked
+    # the pool is genuinely sharded on BOTH axes
+    shard = eng.pool.k.sharding.shard_shape(eng.pool.k.shape)
+    assert shard[0] == cfg.n_layers // 2           # layers over 'stage'
+    assert shard[3] == eng.pool.k.shape[3] // 2    # kv over 'model'
+    if kv_dtype is not None:
+        sc = eng.pool.k_scale.sharding.shard_shape(eng.pool.k_scale.shape)
+        assert sc[0] == cfg.n_layers // 2
 
 
 def test_pp_tp_exclusions(cpu_devices):
-    """PP×TP rejects loudly: distinct meshes, quantized KV, quantized
-    weights, and the paged engine."""
+    """PP×TP rejects loudly: distinct meshes, quantized weights, MoE
+    models, and Megatron SP (quantized KV and the paged engine now
+    compose — see the parity tests above)."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine import make_engine
     from k8s_llm_rca_tpu.models.quant import quantize_params
@@ -1305,18 +1470,16 @@ def test_pp_tp_exclusions(cpu_devices):
     ecfg = EngineConfig(max_batch=2, max_seq_len=64, prefill_buckets=(16,))
     with pytest.raises(ValueError, match="SAME composed mesh"):
         make_engine(cfg, ecfg, params, tok, pp_mesh=mesh, tp_mesh=mesh_b)
-    with pytest.raises(ValueError, match="full-precision KV"):
-        make_engine(cfg, dataclasses.replace(ecfg, kv_cache_dtype="int8"), params, tok,
-                    pp_mesh=mesh, tp_mesh=mesh)
     with pytest.raises(ValueError, match="unquantized weights"):
         make_engine(cfg, ecfg, quantize_params(params, bits=8), tok,
                     pp_mesh=mesh, tp_mesh=mesh)
-    with pytest.raises(ValueError, match="paged PP×TP"):
+    with pytest.raises(ValueError, match="unquantized weights"):
+        # the paged engine applies the same weight-quantization rejection
         make_engine(cfg, dataclasses.replace(ecfg, paged=True, page_size=16,
-                                        num_pages=16,
-                                        prefix_cache=False),
-                    params, tok, pp_mesh=mesh, tp_mesh=mesh,
-                    use_kernel=False)
+                                             num_pages=16,
+                                             prefix_cache=False),
+                    quantize_params(params, bits=8), tok,
+                    pp_mesh=mesh, tp_mesh=mesh, use_kernel=False)
     with pytest.raises(ValueError, match="MoE"):
         moe_cfg = TINY_MOE.replace(n_layers=4, n_experts=4, max_seq_len=64)
         make_engine(moe_cfg, ecfg,

@@ -115,6 +115,63 @@ def test_run_file_shared_workers_oracle(tmp_path):
     assert run_file.completed_incidents(out) == 8
 
 
+def test_run_file_chaos_kill_and_resume(tmp_path):
+    """Chaos: SIGKILL the shared-engine sweep process mid-flight, then
+    --resume.  The resumed run must complete the sweep with NO duplicated
+    and NO lost incidents — even though concurrent workers complete
+    incidents out of input order (so a count-based "skip the first N"
+    would corrupt the sweep) and the kill can leave a partial tail record
+    (which resume truncates)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+    from collections import Counter
+
+    inp = str(tmp_path / "incidents.csv")
+    out = str(tmp_path / "results.json")
+    run_file.write_default_corpus(inp, repeat=6)    # 24 incidents
+    corpus = run_file.load_corpus(inp)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "k8s_llm_rca_tpu.sweeps.run_file",
+         "--input", inp, "--output", out, "--workers", "4"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            done = run_file.completed_incidents(out)
+            if done >= 4:
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)    # hard kill, mid-append ok
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    survivors, _ = run_file.scan_output(out)
+    # the kill must land mid-sweep for the test to mean anything
+    assert 0 < len(survivors) < len(corpus), len(survivors)
+
+    summary = run_file.main([
+        "--input", inp, "--output", out, "--workers", "4", "--resume"])
+    assert summary["incidents"] == len(corpus) - len(survivors)
+
+    final, _ = run_file.scan_output(out)
+    # exactly-once at incident granularity: multiset equality with input
+    assert Counter(final) == Counter(corpus), (
+        Counter(final) - Counter(corpus), Counter(corpus) - Counter(final))
+
+
 def test_run_file_shared_workers_engine(tmp_path):
     """Concurrent workers over ONE TINY engine: the continuous batcher
     carries runs from different incidents in the same ticks, and the
