@@ -203,14 +203,39 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
     over the TP group (llama._quantize_kv axis_name), so scale caches
     replicate across TP and numerics match the plain quantized paths
     exactly.  PP×TP still requires unquantized WEIGHTS (the shard_map
-    spec tree matches plain tensors).  CP/EP remain exclusive, as does
-    speculative decoding (decode_multi has no pipelined equivalent, and
-    _speculation_applies would silently never fire)."""
+    spec tree matches plain tensors).
+
+    PP composes with EP on ONE mesh carrying "stage" and "expert"
+    (Mixtral across pods: stages over DCN, expert dispatch over ICI
+    within each stage).  Stage bodies run dense attention on the
+    replicated stream and route each expert peer's token slice through
+    the shared all-to-all dispatch (parallel/pipeline._moe_mlp_ep);
+    PP×TP×EP is not composed (the manual-TP stage block computes a
+    dense MLP).  CP remains exclusive, as does speculative decoding
+    (decode_multi has no pipelined equivalent, and _speculation_applies
+    would silently never fire)."""
     if pp_mesh is None:
         return None
-    for other, name in ((cp_mesh, "cp_mesh"), (ep_mesh, "ep_mesh")):
-        if other is not None:
-            raise ValueError(f"pp_mesh and {name} are mutually exclusive")
+    if cp_mesh is not None:
+        raise ValueError("pp_mesh and cp_mesh are mutually exclusive")
+    if ep_mesh is not None:
+        if ep_mesh is not pp_mesh:
+            raise ValueError(
+                "pp_mesh and ep_mesh must be the SAME composed mesh "
+                "(one Mesh carrying 'stage' and 'expert'); two distinct "
+                "meshes cannot both lay out the weights")
+        if tp_mesh is not None:
+            raise ValueError(
+                "PP×TP×EP is unsupported (the manual-TP stage block "
+                "computes a dense MLP; compose PP×EP or PP×TP)")
+        n_ep = ep_mesh.shape["expert"]
+        m_ep = microbatches or pp_mesh.shape[stage_axis]
+        if (engine_cfg.max_batch // max(1, m_ep)) % n_ep:
+            raise ValueError(
+                f"PP×EP needs the microbatch size "
+                f"{engine_cfg.max_batch}//{m_ep} divisible by the expert "
+                f"axis {n_ep} (each expert peer routes a distinct token "
+                f"slice of the microbatch)")
     if tp_mesh is not None:
         if tp_mesh is not pp_mesh:
             raise ValueError(
@@ -255,6 +280,24 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
         raise ValueError("speculative decoding is unsupported under PP "
                          "(no pipelined decode_multi); set speculative_k=0")
     return m
+
+
+def setup_draft(draft_model, model_cfg, engine_cfg):
+    """Validate + build the ModelDraft for ``draft_model=(cfg, params)``
+    (shared by both engine constructors); None passes through."""
+    if draft_model is None:
+        return None
+    if engine_cfg.speculative_k <= 0:
+        raise ValueError("draft_model requires speculative_k > 0 "
+                         "(the draft only exists to fill draft slots)")
+    dcfg, dparams = draft_model
+    if dcfg.vocab_size != model_cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {dcfg.vocab_size} != target vocab "
+            f"{model_cfg.vocab_size} (draft tokens must be target tokens)")
+    from k8s_llm_rca_tpu.engine.speculative import ModelDraft
+
+    return ModelDraft(dcfg, dparams, engine_cfg)
 
 
 def validate_cp_divisibility(cp_seq_axis: str, n_cp: int, sizes) -> None:
@@ -319,6 +362,8 @@ class EngineBase:
     # batched pipelined prefill, padded to _pp_m microbatch multiples
     _pp: bool = False
     _pp_m: Optional[int] = None
+    # draft-model speculation (speculative.ModelDraft); None = n-gram drafts
+    _draft = None
 
     # -------------------------------------------------------- shared api
 
@@ -717,14 +762,28 @@ class EngineBase:
 
     def _build_drafts(self, active_slots, cur_host
                       ) -> Tuple[np.ndarray, Dict[int, List[int]]]:
-        """n-gram prompt-lookup drafts per slot: (tokens_in [B, k+1],
-        drafts {slot: draft})."""
+        """Per-slot draft proposals: (tokens_in [B, k+1], drafts {slot:
+        draft}).  Drafts come from the draft MODEL when one is attached
+        (constructor ``draft_model=``), else n-gram prompt lookup."""
         from k8s_llm_rca_tpu.engine.speculative import ngram_draft
 
         k_spec = self.engine_cfg.speculative_k
         tokens_in = np.zeros((self.engine_cfg.max_batch, k_spec + 1),
                              np.int32)
         drafts: Dict[int, List[int]] = {}
+        if self._draft is not None:
+            for slot in active_slots:
+                st = self._active[slot]
+                ctx = (self._prompts.get(st.seq_id, [])
+                       + self._stop_context(st))
+                self._draft.sync(slot, st.seq_id, ctx)
+            drafts = self._draft.draft(active_slots, k_spec,
+                                       self.tokenizer.eos_id)
+            for slot in active_slots:
+                tokens_in[slot, 0] = cur_host[slot]
+                d = drafts[slot]
+                tokens_in[slot, 1:1 + len(d)] = d
+            return tokens_in, drafts
         for slot in active_slots:
             st = self._active[slot]
             # _stop_context (not st.generated) so a resumed sequence's
@@ -772,7 +831,7 @@ class EngineBase:
             st = self._active[slot]
             draft = drafts[slot]
             base_len = st.prompt_tokens + len(st.generated)
-            committed = 0
+            committed: List[int] = []
             reason = None
             for j in range(len(draft) + 1):
                 if constrained:
@@ -785,7 +844,7 @@ class EngineBase:
                 st.generated.append(token)
                 if st.grammar is not None:
                     st.grammar.advance(token)
-                committed += 1
+                committed.append(token)
                 if post_commit is not None:
                     post_commit(slot, token)
                 # cache now holds j+1 more tokens than before this commit:
@@ -796,11 +855,13 @@ class EngineBase:
                             and token == draft[j])
                 if not accepted:
                     break
-            METRICS.inc("engine.decode_tokens", committed)
+            METRICS.inc("engine.decode_tokens", len(committed))
             METRICS.inc("engine.spec_drafted", len(draft))
-            METRICS.inc("engine.spec_accepted", max(0, committed - 1))
+            METRICS.inc("engine.spec_accepted", max(0, len(committed) - 1))
             if reason is not None:
                 finished.append(self._retire(slot, reason))
+            elif self._draft is not None:
+                self._draft.advance(slot, st.seq_id, committed)
         return finished
 
     def _need_spec_logits(self, active_slots) -> bool:
@@ -849,8 +910,15 @@ class InferenceEngine(EngineBase):
         pp_microbatches: Optional[int] = None,
         pp_stage_axis: str = "stage",
         sp: bool = False,
+        draft_model=None,
     ):
-        """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
+        """``draft_model``: optional (ModelConfig, params) of a small
+        draft Llama (same vocabulary) — speculation then drafts with the
+        model instead of n-gram prompt lookup (engine/speculative.py
+        ModelDraft; requires ``speculative_k > 0``).  A distilled
+        checkpoint (rca/distill.py) is the intended source.
+
+        ``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         then runs context-parallel over it (long-context mode; the axis
         size must divide every prefill bucket and max_seq_len, validated
         below).  ``cp_mode``: "ring" (ppermute KV rotation) or "ulysses"
@@ -898,6 +966,7 @@ class InferenceEngine(EngineBase):
         self.engine_cfg = engine_cfg
         self.params = params
         self.tokenizer = tokenizer
+        self._draft = setup_draft(draft_model, model_cfg, engine_cfg)
         self.sampling = SamplingParams(
             temperature=engine_cfg.temperature,
             top_k=engine_cfg.top_k,
@@ -1013,10 +1082,12 @@ class InferenceEngine(EngineBase):
             from k8s_llm_rca_tpu.parallel import pipeline as pp
 
             pp_tp_axis = "model" if tp_mesh is not None else None
+            pp_ep_axis = "expert" if ep_mesh is not None else None
             n_stages = pp_mesh.shape[pp_stage_axis]
             stacked = pp.shard_stacked_layers(
                 pp.stack_llama_stages(params, n_stages), pp_mesh,
-                pp_stage_axis, cfg=model_cfg, tp_axis=pp_tp_axis)
+                pp_stage_axis, cfg=model_cfg, tp_axis=pp_tp_axis,
+                ep_axis=pp_ep_axis)
             light = {k: v for k, v in params.items() if k != "layers"}
             self.params = (light, stacked)
             m = self._pp_m
@@ -1025,13 +1096,15 @@ class InferenceEngine(EngineBase):
                 p, stk = params_t
                 return pp.llama_pp_prefill(cfg, p, cache, toks, lens,
                                            pp_mesh, m, pp_stage_axis, stk,
-                                           slots, tp_axis=pp_tp_axis)
+                                           slots, tp_axis=pp_tp_axis,
+                                           ep_axis=pp_ep_axis)
 
             def pp_decode_fn(cfg, params_t, cache, toks, lens):
                 p, stk = params_t
                 return pp.llama_pp_decode_step(cfg, p, cache, toks, lens,
                                                pp_mesh, m, pp_stage_axis,
-                                               stk, tp_axis=pp_tp_axis)
+                                               stk, tp_axis=pp_tp_axis,
+                                               ep_axis=pp_ep_axis)
 
             self._prefill = None        # PP admits through the batched path
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0)

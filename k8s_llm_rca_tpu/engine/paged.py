@@ -100,6 +100,15 @@ class PageAllocator:
             self._owner[p] = owner
         return pages
 
+    def _push_free(self, p: int) -> None:
+        """Return one validated page to the free store (subclass hook —
+        the partitioned allocator routes it to the page's partition)."""
+        self._free.append(p)
+
+    def _free_pages(self) -> List[int]:
+        """All free page ids (subclass hook for check())."""
+        return self._free
+
     def free(self, pages: Sequence[int], owner: int) -> None:
         for p in pages:
             if p == TRASH_PAGE:
@@ -111,7 +120,7 @@ class PageAllocator:
                 raise AllocatorError(
                     f"page {p} owned by {got}, freed by {owner}")
             del self._owner[p]
-            self._free.append(p)
+            self._push_free(p)
 
     def transfer(self, pages: Sequence[int], from_owner: int,
                  to_owner: int) -> None:
@@ -131,11 +140,12 @@ class PageAllocator:
 
     def check(self) -> None:
         """Global invariant: free ∪ owned == all pages, disjoint."""
-        free: Set[int] = set(self._free)
+        free_list = self._free_pages()
+        free: Set[int] = set(free_list)
         owned: Set[int] = set(self._owner)
         if free & owned:
             raise AllocatorError(f"pages both free and owned: {free & owned}")
-        if len(free) != len(self._free):
+        if len(free) != len(free_list):
             raise AllocatorError("duplicate entries in free list")
         universe = set(range(1, self.n_pages))
         if free | owned != universe:
@@ -187,37 +197,24 @@ class PartitionedPageAllocator(PageAllocator):
             self._owner[p] = owner
         return pages
 
-    def free(self, pages: Sequence[int], owner: int) -> None:
-        for p in pages:
-            if p == TRASH_PAGE:
-                raise AllocatorError("attempt to free the trash page")
-            got = self._owner.get(p)
-            if got is None:
-                raise AllocatorError(f"double free of page {p}")
-            if got != owner:
-                raise AllocatorError(
-                    f"page {p} owned by {got}, freed by {owner}")
-            del self._owner[p]
-            self._free_parts[self.part_of(p)].append(p)
+    # free()/check() come from PageAllocator through these hooks, so the
+    # safety invariants (double-free / alias / leak detection) stay ONE
+    # implementation
+
+    def _push_free(self, p: int) -> None:
+        self._free_parts[self.part_of(p)].append(p)
+
+    def _free_pages(self) -> List[int]:
+        return [p for part in self._free_parts for p in part]
 
     def check(self) -> None:
-        free: Set[int] = set()
         for i, part in enumerate(self._free_parts):
-            if len(set(part)) != len(part):
-                raise AllocatorError(f"duplicate entries in partition {i}")
             for p in part:
                 if self.part_of(p) != i:
                     raise AllocatorError(
                         f"page {p} in wrong partition {i} "
                         f"(belongs to {self.part_of(p)})")
-            free |= set(part)
-        owned: Set[int] = set(self._owner)
-        if free & owned:
-            raise AllocatorError(f"pages both free and owned: {free & owned}")
-        universe = set(range(1, self.n_pages))
-        if free | owned != universe:
-            raise AllocatorError(
-                f"leaked pages: {sorted(universe - free - owned)}")
+        super().check()
 
 
 def make_allocator(n_pages: int, prefer_native: bool = True):
@@ -728,7 +725,8 @@ class PagedInferenceEngine(EngineBase):
                  cp_mesh=None, cp_seq_axis: str = "seq",
                  cp_mode: str = "ring", ep_mesh=None, tp_mesh=None,
                  pp_mesh=None, pp_microbatches: Optional[int] = None,
-                 pp_stage_axis: str = "stage", sp: bool = False):
+                 pp_stage_axis: str = "stage", sp: bool = False,
+                 draft_model=None):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         runs context-parallel over it (ring or Ulysses, as in the
         contiguous engine) and scatters the full-depth KV into pool pages.
@@ -821,6 +819,9 @@ class PagedInferenceEngine(EngineBase):
         self.params = params
         self.tokenizer = tokenizer
         self.use_kernel = use_kernel
+        from k8s_llm_rca_tpu.engine.engine import setup_draft
+
+        self._draft = setup_draft(draft_model, model_cfg, engine_cfg)
         self.sampling = SamplingParams(
             temperature=engine_cfg.temperature,
             top_k=engine_cfg.top_k,
@@ -956,10 +957,12 @@ class PagedInferenceEngine(EngineBase):
             from k8s_llm_rca_tpu.parallel import pipeline as pp
 
             pp_tp_axis = "model" if tp_mesh is not None else None
+            pp_ep_axis = "expert" if ep_mesh is not None else None
             n_stages = pp_mesh.shape[pp_stage_axis]
             stacked = pp.shard_stacked_layers(
                 pp.stack_llama_stages(params, n_stages), pp_mesh,
-                pp_stage_axis, cfg=model_cfg, tp_axis=pp_tp_axis)
+                pp_stage_axis, cfg=model_cfg, tp_axis=pp_tp_axis,
+                ep_axis=pp_ep_axis)
             self.params = ({k: v for k, v in params.items()
                             if k != "layers"}, stacked)
             m = self._pp_m
@@ -968,14 +971,16 @@ class PagedInferenceEngine(EngineBase):
                 p, stk = params_t
                 return pp.paged_pp_prefill(cfg, p, pool, toks, lens, maps,
                                            pp_mesh, m, pp_stage_axis, stk,
-                                           tp_axis=pp_tp_axis)
+                                           tp_axis=pp_tp_axis,
+                                           ep_axis=pp_ep_axis)
 
             def pp_decode_fn(cfg, params_t, pool, toks, lens, bt,
                              use_kernel=None):
                 p, stk = params_t
                 return pp.paged_pp_decode_step(cfg, p, pool, toks, lens, bt,
                                                pp_mesh, m, pp_stage_axis,
-                                               stk, tp_axis=pp_tp_axis)
+                                               stk, tp_axis=pp_tp_axis,
+                                               ep_axis=pp_ep_axis)
 
             self._prefill = None     # PP admits through the batched path
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0,

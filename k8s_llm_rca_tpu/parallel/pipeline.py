@@ -92,34 +92,37 @@ def stack_llama_stages(params: Any, n_stages: int) -> Any:
 
 
 def stacked_layer_specs(cfg, stage_axis: str = "stage",
-                        tp_axis: str = None) -> Any:
+                        tp_axis: str = None, ep_axis: str = None) -> Any:
     """PartitionSpec tree for a ``stack_llama_stages`` tree: stage axis
-    leading; with ``tp_axis``, each leaf additionally takes its TP dim
-    from runtime.sharding.llama_param_specs shifted past the two stacking
-    dims — the PP×TP weight layout (stage over DCN, heads/hidden over
-    ICI)."""
+    leading; with ``tp_axis`` (PP×TP) each leaf additionally takes its TP
+    dim from runtime.sharding.llama_param_specs shifted past the two
+    stacking dims (stage over DCN, heads/hidden over ICI); with
+    ``ep_axis`` (PP×EP) the stacked expert leaves keep their leading
+    expert dim sharded (stage over DCN, experts over ICI).  Composed
+    axes not being used map to None (replicated)."""
     from k8s_llm_rca_tpu.runtime.sharding import llama_param_specs
 
     layer = llama_param_specs(cfg)["layers"][0]
-    if tp_axis is None:
+    if tp_axis is None and ep_axis is None:
         return {k: P(stage_axis) for k in layer}
-    rename = {"model": tp_axis}
+    rename = {"model": tp_axis, "expert": ep_axis}
     return {k: P(stage_axis, None,
-                 *(rename.get(a, a) for a in spec))
+                 *(rename.get(a, a) if a in rename else a for a in spec))
             for k, spec in layer.items()}
 
 
 def shard_stacked_layers(stacked: Any, mesh: Mesh,
                          stage_axis: str = "stage", cfg=None,
-                         tp_axis: str = None) -> Any:
+                         tp_axis: str = None, ep_axis: str = None) -> Any:
     """Place a ``stack_llama_stages`` tree with its leading stage axis
     sharded over ``mesh[stage_axis]`` — each device then holds ONLY its
     stage's layer weights, which is the HBM win that makes PP serve models
     whose weights exceed one chip.  Serving engines hoist this once.
-    With ``tp_axis`` (requires ``cfg``), leaves also shard their TP dims
-    (stacked_layer_specs) for PP×TP serving."""
-    if tp_axis is not None:
-        specs = stacked_layer_specs(cfg, stage_axis, tp_axis)
+    With ``tp_axis``/``ep_axis`` (requires ``cfg``), leaves also shard
+    their TP/expert dims (stacked_layer_specs) for PP×TP / PP×EP serving.
+    """
+    if tp_axis is not None or ep_axis is not None:
+        specs = stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
         return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
                 for k, v in stacked.items()}
 
@@ -304,9 +307,67 @@ def _decode_finish_tp(cfg, layer, x, attn_flat, tp_axis: str):
     return x + jax.lax.psum((gate * up) @ dq(layer["w_down"]), tp_axis)
 
 
+def _moe_mlp_ep(cfg, layer, x, ep_axis: str):
+    """EP MoE MLP for use INSIDE a shard_map stage body (the PP×EP
+    composition): the residual stream ``x`` [b, s, H] is replicated
+    across ``ep_axis``; each expert peer routes ITS token slice through
+    the shared all-to-all dispatch (parallel.moe._moe_local — expert
+    weights arrive pre-sliced by the stacked specs, leading dim E/P),
+    then the outputs all_gather back to the full token set so the next
+    stage-layer's attention sees every token.  Lossless capacity
+    (capacity = tokens_local * top_k), matching the serving engines'
+    expert_parallel_moe, so PP×EP is exactly the dense MoE function."""
+    from k8s_llm_rca_tpu.models.llama import dq
+    from k8s_llm_rca_tpu.parallel.moe import _moe_local
+
+    b, s, h = x.shape
+    p = jax.lax.axis_size(ep_axis)
+    my = jax.lax.axis_index(ep_axis)
+    t = b * s
+    tl = t // p                     # validated: bm % n_ep == 0
+    flat = x.reshape(t, h)
+    x_local = jax.lax.dynamic_slice(flat, (my * tl, 0), (tl, h))
+    out_local = _moe_local(
+        x_local, dq(layer["router"]), dq(layer["w_gate"]),
+        dq(layer["w_up"]), dq(layer["w_down"]), axis_name=ep_axis,
+        n_experts=cfg.n_experts, top_k=cfg.n_experts_per_tok,
+        capacity=max(1, tl * cfg.n_experts_per_tok))
+    gathered = jax.lax.all_gather(out_local, ep_axis, axis=0, tiled=True)
+    return gathered.reshape(b, s, h)
+
+
+def _block_prefill_ep(cfg, layer, x, angles, positions, seq_lens,
+                      ep_axis: str):
+    """MoE transformer block for use inside a shard_map stage body
+    (PP×EP): dense attention on the replicated stream, MoE MLP through
+    the expert all-to-all (_moe_mlp_ep)."""
+    from k8s_llm_rca_tpu.models.llama import _qkv, dq, rms_norm
+    from k8s_llm_rca_tpu.ops.attention import causal_attention
+
+    b, s, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(cfg, layer, h, angles, positions)
+    attn = causal_attention(q, k, v, seq_lens)
+    x = x + attn.reshape(b, s, -1) @ dq(layer["wo"])
+    hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    x = x + _moe_mlp_ep(cfg, layer, hm, ep_axis)
+    return x, k, v
+
+
+def _decode_finish_ep(cfg, layer, x, attn_flat, ep_axis: str):
+    """Decode-block back half under PP×EP: dense output projection, MoE
+    MLP through the expert all-to-all."""
+    from k8s_llm_rca_tpu.models.llama import dq, rms_norm
+
+    x = x + attn_flat @ dq(layer["wo"])
+    hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    return x + _moe_mlp_ep(cfg, layer, hm, ep_axis)
+
+
 def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
                      microbatches: int = None, stage_axis: str = "stage",
-                     stacked_layers=None, slots=None, tp_axis: str = None):
+                     stacked_layers=None, slots=None, tp_axis: str = None,
+                     ep_axis: str = None):
     """Pipeline-parallel batched prefill with per-stage KV writes.
 
     tokens [B, S_pad] right-padded, lengths [B]; B divides into
@@ -361,6 +422,10 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
                     h2, k, v = _block_prefill_tp(cfg, layer, carry, angles,
                                                  positions, seq_lens,
                                                  tp_axis)
+                elif ep_axis is not None:
+                    h2, k, v = _block_prefill_ep(cfg, layer, carry, angles,
+                                                 positions, seq_lens,
+                                                 ep_axis)
                 else:
                     h2, k, v = L._block_prefill(cfg, layer, carry, angles,
                                                 positions, seq_lens)
@@ -390,8 +455,9 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
-                    if tp_axis is not None else P(stage_axis))
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+                    if (tp_axis is not None or ep_axis is not None)
+                    else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
@@ -409,7 +475,7 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
 def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                          microbatches: int = None,
                          stage_axis: str = "stage", stacked_layers=None,
-                         tp_axis: str = None):
+                         tp_axis: str = None, ep_axis: str = None):
     """One pipeline-parallel decode step for ALL slots.
 
     tokens [B] current token per slot, lengths [B] cached tokens; the B
@@ -491,6 +557,9 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
                 if tp_axis is not None:
                     hx = _decode_finish_tp(cfg, layer, carry,
                                            attn.reshape(bm, 1, -1), tp_axis)
+                elif ep_axis is not None:
+                    hx = _decode_finish_ep(cfg, layer, carry,
+                                           attn.reshape(bm, 1, -1), ep_axis)
                 else:
                     hx = L._decode_finish(
                         cfg, layer, carry, attn.reshape(bm, 1, -1))
@@ -518,8 +587,9 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
-                    if tp_axis is not None else P(stage_axis))
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+                    if (tp_axis is not None or ep_axis is not None)
+                    else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
@@ -540,7 +610,7 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
 def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
                      mesh: Mesh, microbatches: int = None,
                      stage_axis: str = "stage", stacked_layers=None,
-                     tp_axis: str = None):
+                     tp_axis: str = None, ep_axis: str = None):
     """Pipeline-parallel paged prefill: N sequences' KV scattered into
     their pool pages, the pool's LAYER axis sharded over "stage".
 
@@ -594,6 +664,10 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
                     h2, k, v = _block_prefill_tp(cfg, layer, carry, angles,
                                                  positions, seq_lens,
                                                  tp_axis)
+                elif ep_axis is not None:
+                    h2, k, v = _block_prefill_ep(cfg, layer, carry, angles,
+                                                 positions, seq_lens,
+                                                 ep_axis)
                 else:
                     h2, k, v = L._block_prefill(cfg, layer, carry, angles,
                                                 positions, seq_lens)
@@ -625,8 +699,9 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
-                    if tp_axis is not None else P(stage_axis))
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+                    if (tp_axis is not None or ep_axis is not None)
+                    else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
@@ -644,7 +719,7 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
 def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
                          mesh: Mesh, microbatches: int = None,
                          stage_axis: str = "stage", stacked_layers=None,
-                         tp_axis: str = None):
+                         tp_axis: str = None, ep_axis: str = None):
     """One pipeline-parallel paged decode step for ALL slots.
 
     tokens [B]; lengths [B]; block_tables [B, pages_per_seq].  The new
@@ -733,6 +808,9 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
                 if tp_axis is not None:
                     hx = _decode_finish_tp(cfg, layer, carry,
                                            attn.reshape(bm, 1, -1), tp_axis)
+                elif ep_axis is not None:
+                    hx = _decode_finish_ep(cfg, layer, carry,
+                                           attn.reshape(bm, 1, -1), ep_axis)
                 else:
                     hx = L._decode_finish(
                         cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
@@ -745,8 +823,9 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis)
-                    if tp_axis is not None else P(stage_axis))
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+                    if (tp_axis is not None or ep_axis is not None)
+                    else P(stage_axis))
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),

@@ -54,6 +54,65 @@ class ByteTokenizer:
         return len(self.encode(text))
 
 
+class BPETokenizer:
+    """In-tree TRAINABLE byte-level BPE (the SURVEY §2.2 tokenizer row's
+    "BPE via ``tokenizers``", hermetic edition: train on any local corpus,
+    zero network).  Byte-level alphabet means every string is encodable
+    (no unk); specials are <pad>=0, <s>=1, </s>=2.  The distillation path
+    (rca/distill.py) trains one on its transcript corpus — ~3x fewer
+    tokens per prompt than the byte tokenizer, which is the difference
+    between a CPU-trainable and an intractable distill sequence length."""
+
+    def __init__(self, tok, vocab_size: int):
+        self._tok = tok
+        self.vocab_size = vocab_size
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+
+    @classmethod
+    def train(cls, corpus, vocab_size: int = 2048) -> "BPETokenizer":
+        from tokenizers import (
+            Tokenizer as _Tok, decoders, models, pre_tokenizers, trainers,
+        )
+
+        tok = _Tok(models.BPE(unk_token=None))
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tok.decoder = decoders.ByteLevel()
+        trainer = trainers.BpeTrainer(
+            vocab_size=vocab_size,
+            special_tokens=["<pad>", "<s>", "</s>"],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+        tok.train_from_iterator(list(corpus), trainer)
+        return cls(tok, vocab_size)
+
+    def save(self, path: str) -> None:
+        self._tok.save(path)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        from tokenizers import Tokenizer as _Tok
+
+        tok = _Tok.from_file(path)
+        return cls(tok, tok.get_vocab_size())
+
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> List[int]:
+        ids = self._tok.encode(text).ids
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        specials = {self.pad_id, self.bos_id, self.eos_id}
+        return self._tok.decode([i for i in ids if i not in specials])
+
+    def count(self, text: str) -> int:
+        return len(self.encode(text))
+
+
 class HFTokenizer:
     """Wrap a locally available HuggingFace tokenizer (e.g. a mounted
     TinyLlama/Llama-3 checkpoint dir).  Import is deferred so the hermetic

@@ -229,3 +229,38 @@ def test_batched_admission_with_grammar_and_quantized_cache(setup):
     res = {r.seq_id: r for r in eng.run_to_completion()}
     for i in ids:
         jsonlib.loads(res[i].text)
+
+
+def test_prompt_admission_forces_stepwise_while_queued(setup):
+    """prompt_admission=True: while requests are queued the engine ticks
+    stepwise (chunk == 1), so a freed slot is noticed within ONE decode
+    step instead of up to decode_chunk-1; default (False) keeps the full
+    scan chunk (tuned for dispatch-latency-dominated hosts)."""
+    cfg, params, tok = setup
+    prompts = [tok.encode("pod crashloop", add_bos=True),
+               tok.encode("pvc pending", add_bos=True)]
+
+    def build(prompt_admission):
+        ecfg = EngineConfig(max_batch=1, max_seq_len=128,
+                            prefill_buckets=(32,), max_new_tokens=12,
+                            temperature=0.0, decode_chunk=8,
+                            prompt_admission=prompt_admission)
+        eng = InferenceEngine(cfg, ecfg, params, tok)
+        for p in prompts:
+            # budget 12 > decode_chunk 8, so one chunked scan cannot
+            # retire the active sequence mid-assert
+            eng.submit(list(p), max_new_tokens=12)
+        eng.step()                         # admits the first; second queues
+        assert eng._pending and eng._active
+        return eng
+
+    eng = build(True)
+    assert eng._scan_chunk() == 1          # stepwise while the queue waits
+    res = eng.run_to_completion()
+    assert len(res) == 2                   # both complete, greedy unchanged
+
+    eng2 = build(False)
+    assert eng2._scan_chunk() == 8         # default amortizes dispatches
+    res2 = eng2.run_to_completion()
+    for a, b in zip(res, res2):
+        assert a.token_ids == b.token_ids  # knob changes latency, not output

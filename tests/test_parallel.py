@@ -1451,6 +1451,50 @@ def test_pp_tp_paged_engine_matches_plain(cpu_devices, kv_dtype):
         assert sc[0] == cfg.n_layers // 2
 
 
+@pytest.mark.parametrize("paged", [False, True])
+def test_pp_ep_composed_engine_matches_dense(cpu_devices, paged):
+    """PP×EP in ONE mesh (Mixtral across pods: stages over DCN, expert
+    dispatch over ICI within each stage): stacked expert weights shard
+    (stage, expert), stage bodies run dense attention on the replicated
+    stream and route each expert peer's token slice through the shared
+    all-to-all dispatch — exact greedy parity with the dense
+    single-device engine, on both the contiguous and the paged engine."""
+    from k8s_llm_rca_tpu.config import TINY_MOE, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY_MOE.replace(n_layers=4, n_experts=4, max_seq_len=64)
+    mesh = build_mesh(MeshConfig(stage=2, expert=2),
+                      devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True),
+               tok.encode("oom killed container", add_bos=True)]
+    extra = (dict(paged=True, page_size=16, num_pages=32,
+                  prefix_cache=False) if paged else {})
+    for chunk in (1, 4):
+        ecfg = EngineConfig(max_batch=4, max_seq_len=64,
+                            prefill_buckets=(16, 32), max_new_tokens=6,
+                            temperature=0.0, decode_chunk=chunk, **extra)
+        kw = dict(use_kernel=False) if paged else {}
+        with jax.default_matmul_precision("float32"):
+            ref = make_engine(cfg, ecfg, params, tok).generate(
+                prompts, max_new_tokens=6)
+            eng = make_engine(cfg, ecfg, params, tok, pp_mesh=mesh,
+                              ep_mesh=mesh, **kw)
+            got = eng.generate(prompts, max_new_tokens=6)
+        for r, g in zip(ref, got):
+            assert r.token_ids == g.token_ids, (paged, chunk)
+    # expert weights genuinely sharded on BOTH axes: stage × expert
+    _, stacked = eng.params
+    shard = stacked["w_gate"].sharding.shard_shape(stacked["w_gate"].shape)
+    assert shard[0] == 1                            # stages split
+    assert shard[2] == cfg.n_experts // 2           # experts split
+    if paged:
+        eng.allocator.check()
+
+
 def test_pp_tp_exclusions(cpu_devices):
     """PP×TP rejects loudly: distinct meshes, quantized weights, MoE
     models, and Megatron SP (quantized KV and the paged engine now
