@@ -80,11 +80,13 @@ class ModelDraft:
         self.cfg = cfg
         self.params = params
         b = engine_cfg.max_batch
+        self.k = engine_cfg.speculative_k
         self.max_seq = min(cfg.max_seq_len, engine_cfg.max_seq_len)
         self.cache = llama.init_cache(cfg, b, self.max_seq)
         self.lengths = np.zeros((b,), np.int64)
         self.cur = np.zeros((b,), np.int64)
         self._owner: Dict[int, Tuple[int, int]] = {}   # slot -> (seq, ctxlen)
+        self.prefills = 0          # sync re-prefill count (diagnostics/tests)
         self._buckets = tuple(
             s for s in sorted(set(engine_cfg.prefill_buckets))
             if s <= self.max_seq) or (self.max_seq,)
@@ -107,7 +109,15 @@ class ModelDraft:
 
         if self._owner.get(slot) == (seq_id, len(context)):
             return
-        ctx = list(context[-(self.max_seq - 1):])      # tail when too long
+        # tail-clip leaving a real DRAFTING WINDOW (a quarter of the
+        # cache, at least one full k+1 scan): clipping to the cache edge
+        # would leave no headroom, so the slot would re-prefill its full
+        # tail every 1-2 ticks while drafting almost nothing — a pure
+        # dispatch tax, worst on dispatch-bound hosts.  The shorter tail
+        # only affects draft QUALITY; one re-prefill then buys ~window/c
+        # drafting ticks
+        window = max(self.k + 2, self.max_seq // 4)
+        ctx = list(context[-max(2, self.max_seq - window):])
         n = len(ctx) - 1                               # cur token stays out
         if n <= 0:
             self.lengths[slot] = 0
@@ -116,6 +126,7 @@ class ModelDraft:
             return
         padded = np.zeros((1, self._bucket(n)), np.int32)
         padded[0, :n] = ctx[:-1]
+        self.prefills += 1
         self.cache, _ = self._prefill(self.cfg, self.params, self.cache,
                                       jnp.asarray(padded), jnp.int32(n),
                                       jnp.int32(slot))

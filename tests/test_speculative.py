@@ -370,3 +370,35 @@ def test_model_draft_validation():
                     draft_model=(bad_cfg,
                                  llama.init_params(bad_cfg,
                                                    jax.random.PRNGKey(1))))
+
+
+def test_model_draft_long_context_stays_roomy():
+    """A draft whose cache is SMALLER than the target's must keep
+    drafting once the context exceeds it: the sync tail-clip leaves
+    k+1 steps of headroom, so the slot re-prefills only every ~headroom
+    tokens instead of every tick with zero drafts (regression: clipping
+    to the cache edge made long slots a pure per-tick dispatch tax)."""
+    import dataclasses
+
+    from k8s_llm_rca_tpu.engine import make_engine
+
+    cfg = TINY.replace(max_seq_len=256)
+    draft_cfg = cfg.replace(max_seq_len=64)      # draft cache << target
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=1, max_seq_len=256,
+                        prefill_buckets=(32, 64), max_new_tokens=160,
+                        temperature=0.0, speculative_k=3)
+    prompt = tok.encode("the pod the pod the pod", add_bos=True)
+
+    with jax.default_matmul_precision("float32"):
+        plain = make_engine(cfg, dataclasses.replace(ecfg, speculative_k=0),
+                            params, tok)
+        a = plain.generate([list(prompt)], max_new_tokens=160)
+        spec = make_engine(cfg, ecfg, params, tok,
+                           draft_model=(draft_cfg, params))
+        b = spec.generate([list(prompt)], max_new_tokens=160)
+    assert a[0].token_ids == b[0].token_ids
+    # the context passed 64 tokens many times over; re-prefills must be
+    # amortized (~once per ~60-token headroom span), not per-tick
+    assert spec._draft.prefills < 12, spec._draft.prefills
