@@ -211,9 +211,10 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
     replicated stream and route each expert peer's token slice through
     the shared all-to-all dispatch (parallel/pipeline._moe_mlp_ep);
     PP×TP×EP is not composed (the manual-TP stage block computes a
-    dense MLP).  CP remains exclusive, as does speculative decoding
-    (decode_multi has no pipelined equivalent, and _speculation_applies
-    would silently never fire)."""
+    dense MLP).  Speculative decoding composes: the verify step runs the
+    pipelined multi-token decode (parallel/pipeline.llama_pp_decode_multi
+    / paged_pp_decode_multi), so n-gram and draft-model speculation work
+    under PP, PP×TP and PP×EP.  CP remains exclusive."""
     if pp_mesh is None:
         return None
     if cp_mesh is not None:
@@ -276,9 +277,6 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
         raise ValueError(
             f"max_batch={engine_cfg.max_batch} not divisible into "
             f"{m} PP microbatches")
-    if engine_cfg.speculative_k > 0:
-        raise ValueError("speculative decoding is unsupported under PP "
-                         "(no pipelined decode_multi); set speculative_k=0")
     return m
 
 
@@ -1142,13 +1140,22 @@ class InferenceEngine(EngineBase):
             pp_decode_fn if pp_decode_fn is not None
             else functools.partial(llama.decode_step, ep_mesh=ep_mesh),
             static_argnums=0)
-        def _verify_step(cfg, params, cache, tokens, lengths):
-            cache, logits = llama.decode_multi(cfg, params, cache, tokens,
-                                               lengths, ep_mesh=ep_mesh)
-            # greedy choices computed on device: the [B, T] int transfer is
-            # 32000x smaller than the logits; full logits leave the device
-            # only for grammar slots (fetched lazily by the caller)
-            return cache, jnp.argmax(logits, axis=-1), logits
+        if pp_mesh is not None:
+            def _verify_step(cfg, params_t, cache, tokens, lengths):
+                p, stk = params_t
+                return pp.llama_pp_decode_multi(
+                    cfg, p, cache, tokens, lengths, pp_mesh, self._pp_m,
+                    pp_stage_axis, stk, tp_axis=pp_tp_axis,
+                    ep_axis=pp_ep_axis)
+        else:
+            def _verify_step(cfg, params, cache, tokens, lengths):
+                cache, logits = llama.decode_multi(cfg, params, cache,
+                                                   tokens, lengths,
+                                                   ep_mesh=ep_mesh)
+                # greedy choices computed on device: the [B, T] int
+                # transfer is 32000x smaller than the logits; full logits
+                # leave the device only for grammar slots (fetched lazily)
+                return cache, jnp.argmax(logits, axis=-1), logits
 
         self._decode_multi = jax.jit(_verify_step, static_argnums=0)
         self._spec_dfa_greedy = jax.jit(dfa_greedy_multi, static_argnums=3)

@@ -949,6 +949,7 @@ class PagedInferenceEngine(EngineBase):
         # no donation support and would warn on every compile, so gate it.)
         donate = (2,) if jax.default_backend() == "tpu" else ()
         pp_decode_fn = None
+        pp_decode_multi_fn = None
         if pp_mesh is not None:
             # PP serving: layers restacked [P, L/P, ...] and sharded over
             # "stage"; self.params becomes (non-layer params, stacked) —
@@ -981,6 +982,14 @@ class PagedInferenceEngine(EngineBase):
                                                pp_mesh, m, pp_stage_axis,
                                                stk, tp_axis=pp_tp_axis,
                                                ep_axis=pp_ep_axis)
+
+            def pp_decode_multi_fn(cfg, params_t, pool, toks, lens, bt):
+                p, stk = params_t
+                return pp.paged_pp_decode_multi(cfg, p, pool, toks, lens,
+                                                bt, pp_mesh, m,
+                                                pp_stage_axis, stk,
+                                                tp_axis=pp_tp_axis,
+                                                ep_axis=pp_ep_axis)
 
             self._prefill = None     # PP admits through the batched path
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0,
@@ -1039,7 +1048,8 @@ class PagedInferenceEngine(EngineBase):
             static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_multi = jax.jit(
-            functools.partial(paged_decode_multi, ep_mesh=ep_mesh),
+            pp_decode_multi_fn if pp_decode_multi_fn is not None
+            else functools.partial(paged_decode_multi, ep_mesh=ep_mesh),
             static_argnums=0, donate_argnums=donate)
         from k8s_llm_rca_tpu.engine.engine import dfa_greedy_multi
         self._spec_dfa_greedy = jax.jit(dfa_greedy_multi, static_argnums=3)

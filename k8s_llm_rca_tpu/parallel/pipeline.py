@@ -602,6 +602,129 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
     return _rebuild(cache, kv_out), logits
 
 
+def llama_pp_decode_multi(cfg, params, cache, tokens, lengths, mesh: Mesh,
+                          microbatches: int = None,
+                          stage_axis: str = "stage", stacked_layers=None,
+                          tp_axis: str = None, ep_axis: str = None):
+    """Pipeline-parallel MULTI-token decode (speculative verification).
+
+    tokens [B, T] (current token + T-1 drafts per slot, as in
+    ``llama.decode_multi``); lengths [B] cached tokens.  Writes all T
+    tokens' KV at lengths..lengths+T-1 on each stage's local layer slice
+    and returns (cache', greedy [B, T], logits [B, T, V]) — greedy
+    computed on device so the [B, T] int transfer replaces the [B, T, V]
+    logits except for grammar slots.  Composes with PP×TP (manual-TP
+    halves, pmax quant scales) and PP×EP exactly like the single-token
+    ``llama_pp_decode_step``."""
+    from k8s_llm_rca_tpu.models import llama as L
+    from k8s_llm_rca_tpu.ops.attention import decode_attention_multi
+
+    n_stages = mesh.shape[stage_axis]
+    m = microbatches or n_stages
+    b, t = tokens.shape
+    assert b % m == 0, (b, m)
+    bm = b // m
+    assert cfg.n_layers % n_stages == 0
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+    s_max = cache.max_seq_len
+    quant = cache.quantized
+    packed = quant and L._kv_packed(cfg, cache)
+
+    x = L.gather_rows(params["embedding"],
+                      tokens).astype(jnp.dtype(cfg.dtype))      # [B, T, H]
+    h_dim = x.shape[-1]
+    x_mb = x.reshape(m, bm, t, h_dim)
+    lengths_mb = lengths.reshape(m, bm)
+    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    dtype = jnp.dtype(cfg.dtype)
+
+    def local(stage_layers, kv, x_mb, lengths_mb):
+        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
+
+        def stage_apply(h, mb_idx, valid, kv):
+            lens = lengths_mb[mb_idx]                     # [bm]
+            positions = lens[:, None] + jnp.arange(t)[None, :]
+
+            def body(carry, xs):
+                layer, k_li, v_li = xs[0], xs[1], xs[2]
+                q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
+                k_tok = k.reshape(bm, t, -1)   # kv_dim (or TP shard)
+                v_tok = v.reshape(bm, t, -1)
+                kv_last = k_li.shape[-1]
+                orig_k = jax.lax.dynamic_slice(
+                    k_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
+                orig_v = jax.lax.dynamic_slice(
+                    v_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
+                if quant:
+                    ks_li, vs_li = xs[3], xs[4]
+                    k_tok, ks1 = L._quantize_kv(k_tok, packed, tp_axis)
+                    v_tok, vs1 = L._quantize_kv(v_tok, packed, tp_axis)
+                    orig_ks = jax.lax.dynamic_slice(
+                        ks_li, (mb_idx * bm, 0), (bm, s_max))
+                    orig_vs = jax.lax.dynamic_slice(
+                        vs_li, (mb_idx * bm, 0), (bm, s_max))
+                    ks_rows = L._write_tokens_scale(orig_ks, ks1, lens)
+                    vs_rows = L._write_tokens_scale(orig_vs, vs1, lens)
+                else:
+                    ks_rows = vs_rows = None
+                k_rows = L._write_tokens_kv(
+                    orig_k, k_tok.astype(orig_k.dtype), lens)
+                v_rows = L._write_tokens_kv(
+                    orig_v, v_tok.astype(orig_v.dtype), lens)
+                attn = decode_attention_multi(
+                    q,
+                    L._dequant_layer(k_rows, ks_rows, dtype, packed).reshape(
+                        bm, s_max, -1, cfg.head_dim),
+                    L._dequant_layer(v_rows, vs_rows, dtype, packed).reshape(
+                        bm, s_max, -1, cfg.head_dim),
+                    lens + 1)
+                attn_flat = attn.reshape(bm, t, -1)
+                if tp_axis is not None:
+                    hx = _decode_finish_tp(cfg, layer, carry, attn_flat,
+                                           tp_axis)
+                elif ep_axis is not None:
+                    hx = _decode_finish_ep(cfg, layer, carry, attn_flat,
+                                           ep_axis)
+                else:
+                    hx = L._decode_finish(cfg, layer, carry, attn_flat)
+                k_li = jax.lax.dynamic_update_slice(
+                    k_li, jnp.where(valid, k_rows, orig_k),
+                    (mb_idx * bm, 0, 0))
+                v_li = jax.lax.dynamic_update_slice(
+                    v_li, jnp.where(valid, v_rows, orig_v),
+                    (mb_idx * bm, 0, 0))
+                if quant:
+                    ks_li = jax.lax.dynamic_update_slice(
+                        ks_li, jnp.where(valid, ks_rows, orig_ks),
+                        (mb_idx * bm, 0))
+                    vs_li = jax.lax.dynamic_update_slice(
+                        vs_li, jnp.where(valid, vs_rows, orig_vs),
+                        (mb_idx * bm, 0))
+                    return hx, (k_li, v_li, ks_li, vs_li)
+                return hx, (k_li, v_li)
+
+            h, kv = jax.lax.scan(body, h, (layers, *kv))
+            return h, kv
+
+        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
+                           stage_axis)
+
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+                    if (tp_axis is not None or ep_axis is not None)
+                    else P(stage_axis))
+    out, kv_out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis),
+                  P(*(None,) * 4), P(None, None)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
+        check_vma=False,
+    )(stacked, _kv_tuple(cache), x_mb, lengths_mb)
+
+    logits = L._logits(cfg, params, out.reshape(b, t, h_dim))   # [B, T, V]
+    return (_rebuild(cache, kv_out), jnp.argmax(logits, axis=-1), logits)
+
+
 # ---------------------------------------------------------------------------
 # paged-pool PP serving
 # ---------------------------------------------------------------------------
@@ -836,3 +959,116 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
 
     logits = L._logits(cfg, params, out.reshape(b, 1, h_dim))[:, 0]
     return _rebuild(pool, kv_out), logits
+
+
+def paged_pp_decode_multi(cfg, params, pool, tokens, lengths, block_tables,
+                          mesh: Mesh, microbatches: int = None,
+                          stage_axis: str = "stage", stacked_layers=None,
+                          tp_axis: str = None, ep_axis: str = None):
+    """Pipeline-parallel paged MULTI-token decode (speculative
+    verification): all T writes for a slot land in ONE page (the engine
+    bounds T by each slot's in-page room, paged._spec_room_ok), so the
+    page id is computed once per slot; attention reads the gathered
+    dense view of the LOCAL layer slice.  Returns (pool', greedy [B, T],
+    logits [B, T, V]) matching ``paged.paged_decode_multi``, composing
+    with PP×TP (pmax quant scales) and PP×EP like the single-token
+    pipelined step."""
+    from k8s_llm_rca_tpu.models import llama as L
+    from k8s_llm_rca_tpu.engine.paged import _pool_packed
+    from k8s_llm_rca_tpu.ops.attention import decode_attention_multi
+
+    n_stages = mesh.shape[stage_axis]
+    m = microbatches or n_stages
+    b, t = tokens.shape
+    assert b % m == 0, (b, m)
+    bm = b // m
+    assert cfg.n_layers % n_stages == 0
+    page_size = pool.page_size
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+    quant = pool.quantized
+    packed = quant and _pool_packed(cfg, pool)
+    pages_per_seq = block_tables.shape[1]
+    s_max = pages_per_seq * page_size
+
+    x = L.gather_rows(params["embedding"],
+                      tokens).astype(jnp.dtype(cfg.dtype))      # [B, T, H]
+    h_dim = x.shape[-1]
+    x_mb = x.reshape(m, bm, t, h_dim)
+    lengths_mb = lengths.reshape(m, bm)
+    bt_mb = block_tables.reshape(m, bm, pages_per_seq)
+    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    dtype = jnp.dtype(cfg.dtype)
+
+    def local(stage_layers, kv, x_mb, lengths_mb, bt_mb):
+        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
+
+        def stage_apply(h, mb_idx, valid, kv):
+            lens = lengths_mb[mb_idx]                     # [bm]
+            bt = bt_mb[mb_idx]                            # [bm, pages_per_seq]
+            positions = lens[:, None] + jnp.arange(t)[None, :]
+            page_idx = lens // page_size
+            page_ids = jnp.take_along_axis(
+                bt, page_idx[:, None], axis=1)            # [bm, 1]
+            pages2d = jnp.broadcast_to(page_ids, (bm, t))
+            offsets = (lens % page_size)[:, None] + jnp.arange(t)[None, :]
+
+            def body(carry, xs):
+                layer, k_li, v_li = xs[0], xs[1], xs[2]
+                q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
+                k_tok = k.reshape(bm, t, -1)   # kv_dim (or TP shard)
+                v_tok = v.reshape(bm, t, -1)
+                if quant:
+                    ks_li, vs_li = xs[3], xs[4]
+                    k_tok, ks1 = L._quantize_kv(k_tok, packed, tp_axis)
+                    v_tok, vs1 = L._quantize_kv(v_tok, packed, tp_axis)
+                    ks_li = ks_li.at[pages2d, offsets].set(
+                        jnp.where(valid, ks1, ks_li[pages2d, offsets]))
+                    vs_li = vs_li.at[pages2d, offsets].set(
+                        jnp.where(valid, vs1, vs_li[pages2d, offsets]))
+                k_li = k_li.at[pages2d, offsets].set(
+                    jnp.where(valid, k_tok.astype(k_li.dtype),
+                              k_li[pages2d, offsets]))
+                v_li = v_li.at[pages2d, offsets].set(
+                    jnp.where(valid, v_tok.astype(v_li.dtype),
+                              v_li[pages2d, offsets]))
+                k_all = L._dequant_layer(
+                    jnp.take(k_li, bt, axis=0),
+                    jnp.take(ks_li, bt, axis=0) if quant else None,
+                    dtype, packed).reshape(bm, s_max, -1, cfg.head_dim)
+                v_all = L._dequant_layer(
+                    jnp.take(v_li, bt, axis=0),
+                    jnp.take(vs_li, bt, axis=0) if quant else None,
+                    dtype, packed).reshape(bm, s_max, -1, cfg.head_dim)
+                attn = decode_attention_multi(q, k_all, v_all, lens + 1)
+                attn_flat = attn.reshape(bm, t, -1)
+                if tp_axis is not None:
+                    hx = _decode_finish_tp(cfg, layer, carry, attn_flat,
+                                           tp_axis)
+                elif ep_axis is not None:
+                    hx = _decode_finish_ep(cfg, layer, carry, attn_flat,
+                                           ep_axis)
+                else:
+                    hx = L._decode_finish(cfg, layer, carry, attn_flat)
+                return hx, ((k_li, v_li, ks_li, vs_li) if quant
+                            else (k_li, v_li))
+
+            h, kv = jax.lax.scan(body, h, (layers, *kv))
+            return h, kv
+
+        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
+                           stage_axis)
+
+    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+                    if (tp_axis is not None or ep_axis is not None)
+                    else P(stage_axis))
+    out, kv_out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis),
+                  P(*(None,) * 4), P(None, None), P(None, None, None)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
+        check_vma=False,
+    )(stacked, _kv_tuple(pool), x_mb, lengths_mb, bt_mb)
+
+    logits = L._logits(cfg, params, out.reshape(b, t, h_dim))   # [B, T, V]
+    return (_rebuild(pool, kv_out), jnp.argmax(logits, axis=-1), logits)

@@ -84,7 +84,9 @@ class BPETokenizer:
             special_tokens=["<pad>", "<s>", "</s>"],
             initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
         tok.train_from_iterator(list(corpus), trainer)
-        return cls(tok, vocab_size)
+        # the ACTUAL trained size (a small corpus can exhaust its merge
+        # candidates below the requested size); load() reports the same
+        return cls(tok, tok.get_vocab_size())
 
     def save(self, path: str) -> None:
         self._tok.save(path)

@@ -991,6 +991,41 @@ def test_cp_paged_seq_sharded_pool(cpu_devices):
     eng.allocator.check()
 
 
+@pytest.mark.parametrize("paged", [False, True])
+def test_cp_speculative_matches_plain(cpu_devices, paged):
+    """Speculation composes with CP on both engines: the multi-token
+    verify step runs over the seq-sharded cache (contiguous) / the
+    seq-sharded page pool (paged) through GSPMD, with exact greedy
+    parity against the non-speculative non-CP engine."""
+    import dataclasses
+
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=32)
+    mesh = build_mesh(MeshConfig(seq=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    extra = (dict(paged=True, page_size=8, num_pages=16,
+                  prefix_cache=False) if paged else {})
+    kw = dict(use_kernel=False) if paged else {}
+    ecfg = EngineConfig(max_batch=2, max_seq_len=32, prefill_buckets=(16,),
+                        max_new_tokens=10, temperature=0.0, **extra)
+    prompts = [tok.encode("the pod the pod", add_bos=True),
+               tok.encode("pvc bound pvc", add_bos=True)]
+    with jax.default_matmul_precision("float32"):
+        ref = make_engine(cfg, ecfg, params, tok, **kw).generate(
+            [list(p) for p in prompts], max_new_tokens=10)
+        spec = make_engine(cfg, dataclasses.replace(ecfg, speculative_k=3),
+                           params, tok, cp_mesh=mesh, **kw)
+        got = spec.generate([list(p) for p in prompts], max_new_tokens=10)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids, paged
+    if paged:
+        spec.allocator.check()
+
+
 def test_cp_paged_partition_exhaustion_preempts_not_crashes(cpu_devices):
     """CP seq-sharded pool under PARTITION pressure: when the partition a
     growing slot needs is exhausted, evicting the youngest slot may free
@@ -1495,6 +1530,101 @@ def test_pp_ep_composed_engine_matches_dense(cpu_devices, paged):
         eng.allocator.check()
 
 
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("draft", ["ngram", "model", "ngram-int8"])
+def test_pp_speculative_matches_plain(cpu_devices, paged, draft):
+    """Speculation composes with PP on both engines: the verify step runs
+    the PIPELINED multi-token decode (llama_pp_decode_multi /
+    paged_pp_decode_multi) over the stage-sharded cache/pool, with exact
+    greedy parity against the non-speculative non-PP engine — for n-gram
+    drafts, a draft MODEL, and an int8-quantized cache/pool (the
+    pipelined verify's quantized scale-write path)."""
+    import dataclasses
+
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(n_layers=4, max_seq_len=64)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    extra = (dict(paged=True, page_size=16, num_pages=32,
+                  prefix_cache=False) if paged else {})
+    if draft == "ngram-int8":
+        extra["kv_cache_dtype"] = "int8"
+    kw = dict(use_kernel=False) if paged else {}
+    dm = dict(draft_model=(cfg, params)) if draft == "model" else {}
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, prefill_buckets=(16,),
+                        max_new_tokens=10, temperature=0.0, **extra)
+    prompts = [tok.encode("the pod the pod", add_bos=True),
+               tok.encode("pvc bound pvc", add_bos=True)]
+    with jax.default_matmul_precision("float32"):
+        ref = make_engine(cfg, ecfg, params, tok, **kw).generate(
+            [list(p) for p in prompts], max_new_tokens=10)
+        spec = make_engine(cfg, dataclasses.replace(ecfg, speculative_k=3),
+                           params, tok, pp_mesh=mesh, **kw, **dm)
+        got = spec.generate([list(p) for p in prompts], max_new_tokens=10)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids, (paged, draft)
+    if paged:
+        spec.allocator.check()
+
+
+def test_pp_composed_speculative_matches_plain(cpu_devices):
+    """Speculation through the COMPOSED pipelined verify: PP×TP (paged,
+    the pod serving shape) and PP×EP (MoE) both match their
+    non-speculative plain engines exactly."""
+    import dataclasses
+
+    from k8s_llm_rca_tpu.config import TINY, TINY_MOE, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    prompts_txt = ["the pod the pod", "pvc bound pvc"]
+    with jax.default_matmul_precision("float32"):
+        # PP×TP × spec on the paged engine
+        cfg = TINY.replace(n_layers=4, max_seq_len=64)
+        mesh = build_mesh(MeshConfig(stage=2, model=2),
+                          devices=cpu_devices[:4])
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tok = get_tokenizer(vocab_size=cfg.vocab_size)
+        prompts = [tok.encode(t, add_bos=True) for t in prompts_txt]
+        ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                            prefill_buckets=(16,), max_new_tokens=8,
+                            temperature=0.0, paged=True, page_size=16,
+                            num_pages=32, prefix_cache=False)
+        ref = make_engine(cfg, ecfg, params, tok,
+                          use_kernel=False).generate(
+            [list(p) for p in prompts], max_new_tokens=8)
+        spec = make_engine(cfg, dataclasses.replace(ecfg, speculative_k=3),
+                           params, tok, pp_mesh=mesh, tp_mesh=mesh,
+                           use_kernel=False)
+        got = spec.generate([list(p) for p in prompts], max_new_tokens=8)
+        for r, g in zip(ref, got):
+            assert r.token_ids == g.token_ids
+        spec.allocator.check()
+
+        # PP×EP × spec
+        mcfg = TINY_MOE.replace(n_layers=4, n_experts=4, max_seq_len=64)
+        emesh = build_mesh(MeshConfig(stage=2, expert=2),
+                           devices=cpu_devices[:4])
+        mparams = llama.init_params(mcfg, jax.random.PRNGKey(1))
+        mtok = get_tokenizer(vocab_size=mcfg.vocab_size)
+        mp = [mtok.encode(t, add_bos=True) for t in prompts_txt]
+        mecfg = EngineConfig(max_batch=4, max_seq_len=64,
+                             prefill_buckets=(16,), max_new_tokens=8,
+                             temperature=0.0)
+        mref = make_engine(mcfg, mecfg, mparams, mtok).generate(
+            [list(p) for p in mp], max_new_tokens=8)
+        mspec = make_engine(mcfg,
+                            dataclasses.replace(mecfg, speculative_k=3),
+                            mparams, mtok, pp_mesh=emesh, ep_mesh=emesh)
+        mgot = mspec.generate([list(p) for p in mp], max_new_tokens=8)
+        for r, g in zip(mref, mgot):
+            assert r.token_ids == g.token_ids
+
+
 def test_pp_tp_exclusions(cpu_devices):
     """PP×TP rejects loudly: distinct meshes, quantized weights, MoE
     models, and Megatron SP (quantized KV and the paged engine now
@@ -1566,9 +1696,6 @@ def test_pp_mesh_validation(cpu_devices):
     with pytest.raises(ValueError, match="microbatches"):
         make_engine(cfg, EngineConfig(**base), params, tok, pp_mesh=pp,
                     pp_microbatches=3)
-    with pytest.raises(ValueError, match="speculative"):
-        make_engine(cfg, EngineConfig(speculative_k=2, **base), params,
-                    tok, pp_mesh=pp)
     with pytest.raises(ValueError, match="prefix_cache"):
         PagedInferenceEngine(
             cfg, EngineConfig(paged=True, page_size=16, num_pages=32,

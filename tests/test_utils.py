@@ -6,6 +6,47 @@ from k8s_llm_rca_tpu.utils.logging import Metrics
 from k8s_llm_rca_tpu.utils.tokenizer import ByteTokenizer, get_tokenizer
 
 
+class TestBPETokenizer:
+    """In-tree trainable byte-level BPE (utils/tokenizer.BPETokenizer)."""
+
+    def _tok(self):
+        from k8s_llm_rca_tpu.utils.tokenizer import BPETokenizer
+
+        corpus = ["MountVolume.SetUp failed for volume",
+                  'secret "es-account-token" not found',
+                  '{"DestinationKind": "Secret"}'] * 20
+        return BPETokenizer.train(corpus, vocab_size=512)
+
+    def test_roundtrip_exact(self):
+        tok = self._tok()
+        for text in ['secret "x" not found\n', "kubectl apply -f m.yaml",
+                     '{"a": [1, 2], "b": "c\\"d"}', "päivää \u00e9\u00e9"]:
+            assert tok.decode(tok.encode(text)) == text
+
+    def test_compresses_vs_bytes(self):
+        tok = self._tok()
+        text = "MountVolume.SetUp failed for volume: secret not found"
+        assert len(tok.encode(text)) < len(text.encode()) // 2
+
+    def test_specials_and_framing(self):
+        tok = self._tok()
+        ids = tok.encode("pod", add_bos=True, add_eos=True)
+        assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+        assert tok.decode(ids) == "pod"       # specials filtered on decode
+        assert {tok.pad_id, tok.bos_id, tok.eos_id} == {0, 1, 2}
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from k8s_llm_rca_tpu.utils.tokenizer import BPETokenizer
+
+        tok = self._tok()
+        path = str(tmp_path / "bpe.json")
+        tok.save(path)
+        tok2 = BPETokenizer.load(path)
+        text = 'exceeded quota: pods=50'
+        assert tok2.encode(text) == tok.encode(text)
+        assert tok2.vocab_size == tok.vocab_size
+
+
 class TestTokenizer:
     @pytest.mark.parametrize("text", [
         "kubelet Failed to pull image",
