@@ -250,15 +250,24 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
                 f"{model_cfg.n_kv_heads} not divisible by model axis "
                 f"{n_tp} (required for PP×TP stage bodies)")
         if params is not None:
-            from k8s_llm_rca_tpu.models.quant import QuantTensor, QuantTensor4
+            from k8s_llm_rca_tpu.models.quant import QuantTensor4
 
-            if any(isinstance(leaf, (QuantTensor, QuantTensor4))
+            # int8 (QuantTensor) composes: the stacked spec tree expands
+            # per-leaf so payloads shard on the weight spec and
+            # per-channel scales replicate their reduced dims
+            # (pipeline._stacked_in_specs).  int4 does NOT: the split-half
+            # nibble packing interleaves column pairs along the packed
+            # axis, so manually column-sharding it would pair each
+            # device's unpacked columns with the WRONG contiguous scale
+            # block.
+            if any(isinstance(leaf, QuantTensor4)
                    for leaf in jax.tree.leaves(
                        params, is_leaf=lambda x: isinstance(
-                           x, (QuantTensor, QuantTensor4)))):
+                           x, QuantTensor4))):
                 raise ValueError(
-                    "PP×TP requires unquantized weights (the shard_map "
-                    "spec tree matches plain tensors)")
+                    "PP×TP requires int8 or unquantized weights: int4's "
+                    "split-half nibble packing does not commute with "
+                    "manual column sharding of the packed axis")
         if model_cfg.n_experts > 0:
             raise ValueError(
                 "PP×TP does not support MoE models (the manual-TP stage "

@@ -119,18 +119,51 @@ def shard_stacked_layers(stacked: Any, mesh: Mesh,
     stage's layer weights, which is the HBM win that makes PP serve models
     whose weights exceed one chip.  Serving engines hoist this once.
     With ``tp_axis``/``ep_axis`` (requires ``cfg``), leaves also shard
-    their TP/expert dims (stacked_layer_specs) for PP×TP / PP×EP serving.
+    their TP/expert dims (stacked_layer_specs) for PP×TP / PP×EP serving;
+    int8-quantized leaves (``QuantTensor``) shard their payload on the
+    weight spec and their per-channel scales with reduced (size-1) dims
+    replicated — runtime.sharding.shard_pytree's placement rule.
     """
     if tp_axis is not None or ep_axis is not None:
+        from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
+
         specs = stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
-        return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-                for k, v in stacked.items()}
+        return shard_pytree(stacked, specs, mesh)
 
     def _put(x):
         spec = P(stage_axis, *(None,) * (x.ndim - 1))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(_put, stacked)
+
+
+def _stacked_in_specs(stacked: Any, cfg, stage_axis: str,
+                      tp_axis: str = None, ep_axis: str = None):
+    """shard_map in_specs for a stacked layer tree.
+
+    PP-only: the single prefix spec P(stage_axis) broadcasts over every
+    leaf (including QuantTensor sub-leaves, whose q and scale both carry
+    the leading stage dim).  Composed PP×TP / PP×EP: per-key specs, with
+    int8 ``QuantTensor`` leaves expanded to (q spec, scale spec) — the
+    scale takes the weight spec with its size-1 (reduced) dims
+    replicated, mirroring runtime.sharding.shard_pytree's placement so
+    the shard_map view matches where the bytes already live."""
+    from k8s_llm_rca_tpu.models.quant import QuantTensor
+
+    if tp_axis is None and ep_axis is None:
+        return P(stage_axis)
+    base = stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+    out = {}
+    for k, v in stacked.items():
+        spec = base[k]
+        if isinstance(v, QuantTensor):
+            full = tuple(spec) + (None,) * (v.q.ndim - len(spec))
+            scale_spec = P(*(s if d > 1 else None
+                             for s, d in zip(full, v.scale.shape)))
+            out[k] = QuantTensor(q=P(*full), scale=scale_spec)
+        else:
+            out[k] = spec
+    return out
 
 
 def llama_pipeline_forward(cfg, params: Any, tokens: jnp.ndarray, mesh: Mesh,
@@ -455,9 +488,8 @@ def llama_pp_prefill(cfg, params, cache, tokens, lengths, mesh: Mesh,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
-                    if (tp_axis is not None or ep_axis is not None)
-                    else P(stage_axis))
+    stacked_spec = _stacked_in_specs(stacked, cfg, stage_axis, tp_axis,
+                                     ep_axis)
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
@@ -482,124 +514,18 @@ def llama_pp_decode_step(cfg, params, cache, tokens, lengths, mesh: Mesh,
     slots split into ``microbatches`` groups that flow through the stages
     GPipe-style (steady-state keeps every stage busy).  Returns (cache',
     logits [B, V]) matching ``llama.decode_step``, including quantized
-    caches (per-token scales written alongside the int8/int4 rows).
+    caches and the PP×TP / PP×EP compositions.
 
-    Hot paths MUST hoist ``stack_llama_stages`` once and pass
-    ``stacked_layers``: the default restacks every layer's weights (a
-    full-model copy) on every call.
+    This IS the T=1 case of ``llama_pp_decode_multi`` — one shard_map
+    body serves both the regular tick and speculative verification, so
+    the masking/quantize-at-write/finish logic cannot drift between
+    them.  Hot paths MUST hoist ``stack_llama_stages`` once and pass
+    ``stacked_layers``.
     """
-    from k8s_llm_rca_tpu.models import llama as L
-    from k8s_llm_rca_tpu.ops.attention import decode_attention
-
-    n_stages = mesh.shape[stage_axis]
-    m = microbatches or n_stages
-    b = tokens.shape[0]
-    assert b % m == 0, (b, m)
-    bm = b // m
-    assert cfg.n_layers % n_stages == 0
-    stacked = (stacked_layers if stacked_layers is not None
-               else stack_llama_stages(params, n_stages))
-    s_max = cache.max_seq_len
-    quant = cache.quantized
-    packed = quant and L._kv_packed(cfg, cache)
-
-    x = L.gather_rows(params["embedding"],
-                      tokens[:, None]).astype(jnp.dtype(cfg.dtype))  # [B,1,H]
-    h_dim = x.shape[-1]
-    x_mb = x.reshape(m, bm, 1, h_dim)
-    lengths_mb = lengths.reshape(m, bm)
-    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-    dtype = jnp.dtype(cfg.dtype)
-
-    def local(stage_layers, kv, x_mb, lengths_mb):
-        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
-
-        def stage_apply(h, mb_idx, valid, kv):
-            lens = lengths_mb[mb_idx]                     # [bm]
-            positions = lens[:, None]
-
-            def body(carry, xs):
-                layer, k_li, v_li = xs[0], xs[1], xs[2]
-                # shared decode block halves (models/llama._decode_qkv /
-                # _decode_finish) keep PP token-for-token with decode_step
-                q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
-                k_tok = k[:, 0].reshape(bm, -1)   # kv_dim (or TP shard)
-                v_tok = v[:, 0].reshape(bm, -1)
-                kv_last = k_li.shape[-1]          # LOCAL kv width (PP×TP
-                # shards the cache's kv axis; packed int4 halves it)
-                orig_k = jax.lax.dynamic_slice(
-                    k_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
-                orig_v = jax.lax.dynamic_slice(
-                    v_li, (mb_idx * bm, 0, 0), (bm, s_max, kv_last))
-                if quant:
-                    ks_li, vs_li = xs[3], xs[4]
-                    k_tok, ks1 = L._quantize_kv(k_tok, packed, tp_axis)
-                    v_tok, vs1 = L._quantize_kv(v_tok, packed, tp_axis)
-                    orig_ks = jax.lax.dynamic_slice(
-                        ks_li, (mb_idx * bm, 0), (bm, s_max))
-                    orig_vs = jax.lax.dynamic_slice(
-                        vs_li, (mb_idx * bm, 0), (bm, s_max))
-                    ks_rows = L._write_token_scale(orig_ks, ks1, lens)
-                    vs_rows = L._write_token_scale(orig_vs, vs1, lens)
-                else:
-                    ks_rows = vs_rows = None
-                k_rows = L._write_token_kv(
-                    orig_k, k_tok.astype(orig_k.dtype), lens)
-                v_rows = L._write_token_kv(
-                    orig_v, v_tok.astype(orig_v.dtype), lens)
-                attn = decode_attention(
-                    q,
-                    L._dequant_layer(k_rows, ks_rows, dtype, packed).reshape(
-                        bm, s_max, -1, cfg.head_dim),
-                    L._dequant_layer(v_rows, vs_rows, dtype, packed).reshape(
-                        bm, s_max, -1, cfg.head_dim),
-                    lens + 1)
-                if tp_axis is not None:
-                    hx = _decode_finish_tp(cfg, layer, carry,
-                                           attn.reshape(bm, 1, -1), tp_axis)
-                elif ep_axis is not None:
-                    hx = _decode_finish_ep(cfg, layer, carry,
-                                           attn.reshape(bm, 1, -1), ep_axis)
-                else:
-                    hx = L._decode_finish(
-                        cfg, layer, carry, attn.reshape(bm, 1, -1))
-                # garbage-tick masking at ROW granularity: only this
-                # microbatch's bm rows move, not the whole cache slice
-                k_li = jax.lax.dynamic_update_slice(
-                    k_li, jnp.where(valid, k_rows, orig_k),
-                    (mb_idx * bm, 0, 0))
-                v_li = jax.lax.dynamic_update_slice(
-                    v_li, jnp.where(valid, v_rows, orig_v),
-                    (mb_idx * bm, 0, 0))
-                if quant:
-                    ks_li = jax.lax.dynamic_update_slice(
-                        ks_li, jnp.where(valid, ks_rows, orig_ks),
-                        (mb_idx * bm, 0))
-                    vs_li = jax.lax.dynamic_update_slice(
-                        vs_li, jnp.where(valid, vs_rows, orig_vs),
-                        (mb_idx * bm, 0))
-                    return hx, (k_li, v_li, ks_li, vs_li)
-                return hx, (k_li, v_li)
-
-            h, kv = jax.lax.scan(body, h, (layers, *kv))
-            return h, kv
-
-        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
-                           stage_axis)
-
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
-                    if (tp_axis is not None or ep_axis is not None)
-                    else P(stage_axis))
-    out, kv_out = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
-                  P(None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
-        check_vma=False,
-    )(stacked, _kv_tuple(cache), x_mb, lengths_mb)
-
-    logits = L._logits(cfg, params, out.reshape(b, 1, h_dim))[:, 0]
-    return _rebuild(cache, kv_out), logits
+    cache, _, logits = llama_pp_decode_multi(
+        cfg, params, cache, tokens[:, None], lengths, mesh, microbatches,
+        stage_axis, stacked_layers, tp_axis, ep_axis)
+    return cache, logits[:, 0]
 
 
 def llama_pp_decode_multi(cfg, params, cache, tokens, lengths, mesh: Mesh,
@@ -710,9 +636,8 @@ def llama_pp_decode_multi(cfg, params, cache, tokens, lengths, mesh: Mesh,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
-                    if (tp_axis is not None or ep_axis is not None)
-                    else P(stage_axis))
+    stacked_spec = _stacked_in_specs(stacked, cfg, stage_axis, tp_axis,
+                                     ep_axis)
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis),
@@ -822,9 +747,8 @@ def paged_pp_prefill(cfg, params, pool, tokens, lengths, page_maps,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
-                    if (tp_axis is not None or ep_axis is not None)
-                    else P(stage_axis))
+    stacked_spec = _stacked_in_specs(stacked, cfg, stage_axis, tp_axis,
+                                     ep_axis)
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
@@ -850,116 +774,17 @@ def paged_pp_decode_step(cfg, params, pool, tokens, lengths, block_tables,
     slice; attention reads the gathered dense view (the XLA paged path —
     pallas_call has no SPMD rule, and per-stage grids are small).  Returns
     (pool', logits [B, V]) matching ``paged.paged_decode_step``, incl.
-    quantized pools.  Hot paths must pass a hoisted ``stacked_layers``.
+    quantized pools and the PP×TP / PP×EP compositions.
 
-    ``tp_axis``: paged PP×TP — the stage body's qkv/attention run on the
-    local head shard (weights sharded (stage, tp), pool kv axis sharded
-    over ``tp_axis``) with psum combines in the block back half; quantized
-    pools use the pmax full-row scale, scale pools replicated across TP.
+    This IS the T=1 case of ``paged_pp_decode_multi`` — one shard_map
+    body serves both the regular tick and speculative verification, so
+    the masking/quantize-at-write/finish logic cannot drift between
+    them.  Hot paths must pass a hoisted ``stacked_layers``.
     """
-    from k8s_llm_rca_tpu.models import llama as L
-    from k8s_llm_rca_tpu.engine.paged import _pool_packed
-    from k8s_llm_rca_tpu.ops.attention import decode_attention
-
-    n_stages = mesh.shape[stage_axis]
-    m = microbatches or n_stages
-    b = tokens.shape[0]
-    assert b % m == 0, (b, m)
-    bm = b // m
-    assert cfg.n_layers % n_stages == 0
-    page_size = pool.page_size
-    stacked = (stacked_layers if stacked_layers is not None
-               else stack_llama_stages(params, n_stages))
-    quant = pool.quantized
-    packed = quant and _pool_packed(cfg, pool)
-    pages_per_seq = block_tables.shape[1]
-    s_max = pages_per_seq * page_size
-
-    x = L.gather_rows(params["embedding"],
-                      tokens[:, None]).astype(jnp.dtype(cfg.dtype))  # [B,1,H]
-    h_dim = x.shape[-1]
-    x_mb = x.reshape(m, bm, 1, h_dim)
-    lengths_mb = lengths.reshape(m, bm)
-    bt_mb = block_tables.reshape(m, bm, pages_per_seq)
-    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-    dtype = jnp.dtype(cfg.dtype)
-
-    def local(stage_layers, kv, x_mb, lengths_mb, bt_mb):
-        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
-
-        def stage_apply(h, mb_idx, valid, kv):
-            lens = lengths_mb[mb_idx]                     # [bm]
-            bt = bt_mb[mb_idx]                            # [bm, pages_per_seq]
-            positions = lens[:, None]
-            page_idx = lens // page_size
-            page_ids = jnp.take_along_axis(
-                bt, page_idx[:, None], axis=1)[:, 0]      # [bm]
-            offsets = lens % page_size                    # [bm]
-
-            def body(carry, xs):
-                layer, k_li, v_li = xs[0], xs[1], xs[2]
-                # _decode_qkv derives head counts from the projection
-                # widths, so local TP weight shards yield local heads
-                q, k, v = L._decode_qkv(cfg, layer, carry, angles, positions)
-                k_tok = k[:, 0].reshape(bm, -1)   # kv_dim (or TP shard)
-                v_tok = v[:, 0].reshape(bm, -1)
-                if quant:
-                    ks_li, vs_li = xs[3], xs[4]
-                    k_tok, ks1 = L._quantize_kv(k_tok, packed, tp_axis)
-                    v_tok, vs1 = L._quantize_kv(v_tok, packed, tp_axis)
-                    ks_li = ks_li.at[page_ids, offsets].set(
-                        jnp.where(valid, ks1, ks_li[page_ids, offsets]))
-                    vs_li = vs_li.at[page_ids, offsets].set(
-                        jnp.where(valid, vs1, vs_li[page_ids, offsets]))
-                k_li = k_li.at[page_ids, offsets].set(
-                    jnp.where(valid, k_tok.astype(k_li.dtype),
-                              k_li[page_ids, offsets]))
-                v_li = v_li.at[page_ids, offsets].set(
-                    jnp.where(valid, v_tok.astype(v_li.dtype),
-                              v_li[page_ids, offsets]))
-                # gathered dense per-sequence view of the LOCAL layer slice
-                # (head count from the local width: kv_dim/t under PP×TP)
-                k_all = L._dequant_layer(
-                    jnp.take(k_li, bt, axis=0),
-                    jnp.take(ks_li, bt, axis=0) if quant else None,
-                    dtype, packed).reshape(bm, s_max, -1, cfg.head_dim)
-                v_all = L._dequant_layer(
-                    jnp.take(v_li, bt, axis=0),
-                    jnp.take(vs_li, bt, axis=0) if quant else None,
-                    dtype, packed).reshape(bm, s_max, -1, cfg.head_dim)
-                attn = decode_attention(q, k_all, v_all, lens + 1)
-                if tp_axis is not None:
-                    hx = _decode_finish_tp(cfg, layer, carry,
-                                           attn.reshape(bm, 1, -1), tp_axis)
-                elif ep_axis is not None:
-                    hx = _decode_finish_ep(cfg, layer, carry,
-                                           attn.reshape(bm, 1, -1), ep_axis)
-                else:
-                    hx = L._decode_finish(
-                        cfg, layer, carry, attn.reshape(bm, 1, cfg.q_dim))
-                return hx, ((k_li, v_li, ks_li, vs_li) if quant
-                            else (k_li, v_li))
-
-            h, kv = jax.lax.scan(body, h, (layers, *kv))
-            return h, kv
-
-        return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
-                           stage_axis)
-
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
-                    if (tp_axis is not None or ep_axis is not None)
-                    else P(stage_axis))
-    out, kv_out = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis), P(*(None,) * 4),
-                  P(None, None), P(None, None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
-        check_vma=False,
-    )(stacked, _kv_tuple(pool), x_mb, lengths_mb, bt_mb)
-
-    logits = L._logits(cfg, params, out.reshape(b, 1, h_dim))[:, 0]
-    return _rebuild(pool, kv_out), logits
-
+    pool, _, logits = paged_pp_decode_multi(
+        cfg, params, pool, tokens[:, None], lengths, block_tables, mesh,
+        microbatches, stage_axis, stacked_layers, tp_axis, ep_axis)
+    return pool, logits[:, 0]
 
 def paged_pp_decode_multi(cfg, params, pool, tokens, lengths, block_tables,
                           mesh: Mesh, microbatches: int = None,
@@ -1059,9 +884,8 @@ def paged_pp_decode_multi(cfg, params, pool, tokens, lengths, block_tables,
         return _gpipe_loop(stage_apply, x_mb, kv, m, n_st, my, perm,
                            stage_axis)
 
-    stacked_spec = (stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
-                    if (tp_axis is not None or ep_axis is not None)
-                    else P(stage_axis))
+    stacked_spec = _stacked_in_specs(stacked, cfg, stage_axis, tp_axis,
+                                     ep_axis)
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
         in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis),

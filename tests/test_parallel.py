@@ -1625,10 +1625,57 @@ def test_pp_composed_speculative_matches_plain(cpu_devices):
             assert r.token_ids == g.token_ids
 
 
+@pytest.mark.parametrize("paged", [False, True])
+def test_pp_tp_quantized_weights_matches_plain(cpu_devices, paged):
+    """int8 WEIGHTS compose with PP×TP (the quantized-flagship pod
+    serving shape): stacked QuantTensor leaves shard their payload on
+    the weight spec and their per-channel scales with reduced dims
+    replicated, and the manual-TP stage bodies dequantize local shards
+    — exact greedy parity with the plain engine on the same quantized
+    params."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.models.quant import quantize_params
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(n_layers=4, max_seq_len=64)
+    mesh = build_mesh(MeshConfig(stage=2, model=2),
+                      devices=cpu_devices[:4])
+    params = quantize_params(
+        llama.init_params(cfg, jax.random.PRNGKey(0)),
+        compute_dtype=jnp.float32, bits=8)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    extra = (dict(paged=True, page_size=16, num_pages=32,
+                  prefix_cache=False) if paged else {})
+    kw = dict(use_kernel=False) if paged else {}
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64,
+                        prefill_buckets=(16, 32), max_new_tokens=6,
+                        temperature=0.0, kv_cache_dtype="int8", **extra)
+    prompts = [tok.encode("pod crashloop kube-system", add_bos=True),
+               tok.encode("node disk pressure taint", add_bos=True)]
+    with jax.default_matmul_precision("float32"):
+        ref = make_engine(cfg, ecfg, params, tok, **kw).generate(
+            prompts, max_new_tokens=6)
+        eng = make_engine(cfg, ecfg, params, tok, pp_mesh=mesh,
+                          tp_mesh=mesh, **kw)
+        got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids, paged
+    # the int8 payloads are genuinely sharded on BOTH axes
+    _, stacked = eng.params
+    shard = stacked["wq"].q.sharding.shard_shape(stacked["wq"].q.shape)
+    assert shard[0] == 1                          # stages split
+    assert shard[3] == stacked["wq"].q.shape[3] // 2   # columns over model
+    if paged:
+        eng.allocator.check()
+
+
 def test_pp_tp_exclusions(cpu_devices):
-    """PP×TP rejects loudly: distinct meshes, quantized weights, MoE
-    models, and Megatron SP (quantized KV and the paged engine now
-    compose — see the parity tests above)."""
+    """PP×TP rejects loudly: distinct meshes, int4-PACKED weights (the
+    split-half nibble layout doesn't commute with manual column
+    sharding; int8 weights, quantized KV and the paged engine all
+    compose — see the parity tests above), MoE models, and Megatron
+    SP."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine import make_engine
     from k8s_llm_rca_tpu.models.quant import quantize_params
@@ -1644,15 +1691,15 @@ def test_pp_tp_exclusions(cpu_devices):
     ecfg = EngineConfig(max_batch=2, max_seq_len=64, prefill_buckets=(16,))
     with pytest.raises(ValueError, match="SAME composed mesh"):
         make_engine(cfg, ecfg, params, tok, pp_mesh=mesh, tp_mesh=mesh_b)
-    with pytest.raises(ValueError, match="unquantized weights"):
-        make_engine(cfg, ecfg, quantize_params(params, bits=8), tok,
+    with pytest.raises(ValueError, match="int8 or unquantized"):
+        make_engine(cfg, ecfg, quantize_params(params, bits=4), tok,
                     pp_mesh=mesh, tp_mesh=mesh)
-    with pytest.raises(ValueError, match="unquantized weights"):
-        # the paged engine applies the same weight-quantization rejection
+    with pytest.raises(ValueError, match="int8 or unquantized"):
+        # the paged engine applies the same int4-weight rejection
         make_engine(cfg, dataclasses.replace(ecfg, paged=True, page_size=16,
                                              num_pages=16,
                                              prefix_cache=False),
-                    quantize_params(params, bits=8), tok,
+                    quantize_params(params, bits=4), tok,
                     pp_mesh=mesh, tp_mesh=mesh, use_kernel=False)
     with pytest.raises(ValueError, match="MoE"):
         moe_cfg = TINY_MOE.replace(n_layers=4, n_experts=4, max_seq_len=64)
