@@ -419,6 +419,36 @@ def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
     return pool, logits
 
 
+def _chunk_layer(cfg: ModelConfig, layer, x, angles, positions, mask,
+                 k_pages, v_pages, k_scales, v_scales, prefix_table,
+                 dtype, packed: bool, ep_mesh=None):
+    """One transformer layer of chunked prefix prefill: gather + dequant
+    the layer's cached prefix pages, attend chunk-over-(prefix + chunk)
+    with the absolute-position mask, finish the block.  Returns
+    (x', k, v) with k/v the chunk's NEW KV [1, C, n_kv, d] — the caller
+    owns the page write (plain path batches it across layers;
+    the pipelined path scatters per stage with GPipe valid-masking).
+    ONE implementation for both, so the chunk attention/mask/dequant
+    contract cannot drift between them."""
+    c_pad = x.shape[1]
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = llama._qkv(cfg, layer, h, angles, positions)
+    # gather + dequant the cached prefix: [1, S_pre, n_kv, d]
+    kp = _gather_dequant_pages(
+        k_pages, k_scales, prefix_table[None], cfg.n_kv_heads,
+        cfg.head_dim, dtype, packed)
+    vp = _gather_dequant_pages(
+        v_pages, v_scales, prefix_table[None], cfg.n_kv_heads,
+        cfg.head_dim, dtype, packed)
+    attn = _chunk_attention(cfg, q,
+                            jnp.concatenate([kp, k], axis=1),
+                            jnp.concatenate([vp, v], axis=1), mask)
+    x = x + attn.reshape(1, c_pad, cfg.q_dim) @ dq(layer["wo"])
+    hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    x = x + llama._mlp(cfg, layer, hm, ep_mesh)
+    return x, k, v
+
+
 def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
                         tokens: jnp.ndarray, chunk_len: jnp.ndarray,
                         prefix_len: jnp.ndarray, prefix_table: jnp.ndarray,
@@ -455,21 +485,12 @@ def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
 
     ks, vs = [], []
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = llama._qkv(cfg, layer, h, angles, positions)
-        # gather + dequant the cached prefix: [1, S_pre, n_kv, d]
-        kp = _gather_dequant_pages(
-            pool.k[li], pool.k_scale[li] if pool.quantized else None,
-            prefix_table[None], cfg.n_kv_heads, cfg.head_dim, dtype, packed)
-        vp = _gather_dequant_pages(
-            pool.v[li], pool.v_scale[li] if pool.quantized else None,
-            prefix_table[None], cfg.n_kv_heads, cfg.head_dim, dtype, packed)
-        attn = _chunk_attention(cfg, q,
-                                jnp.concatenate([kp, k], axis=1),
-                                jnp.concatenate([vp, v], axis=1), mask)
-        x = x + attn.reshape(1, c_pad, cfg.q_dim) @ dq(layer["wo"])
-        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + llama._mlp(cfg, layer, hm, ep_mesh)
+        x, k, v = _chunk_layer(
+            cfg, layer, x, angles, positions, mask,
+            pool.k[li], pool.v[li],
+            pool.k_scale[li] if pool.quantized else None,
+            pool.v_scale[li] if pool.quantized else None,
+            prefix_table, dtype, packed, ep_mesh)
         ks.append(k[0])
         vs.append(v[0])
 
@@ -763,10 +784,13 @@ class PagedInferenceEngine(EngineBase):
                                       params=params)
         self._pp = pp_mesh is not None
         if self._pp:
-            if engine_cfg.prefix_cache:
+            if engine_cfg.prefix_cache and (tp_mesh is not None
+                                            or ep_mesh is not None):
                 raise ValueError(
-                    "pp_mesh requires prefix_cache=False (the chunked "
-                    "prefix prefill path is not pipeline-parallel)")
+                    "prefix_cache composes with stage-only PP; the "
+                    "chunked prefix prefill is per-sequence and not "
+                    "TP/EP-composed — use prefix_cache=False under "
+                    "PP×TP / PP×EP")
             if use_kernel:
                 raise ValueError(
                     "use_kernel=True is incompatible with pp_mesh (the "
@@ -991,8 +1015,20 @@ class PagedInferenceEngine(EngineBase):
                                                 tp_axis=pp_tp_axis,
                                                 ep_axis=pp_ep_axis)
 
+            def _pp_prefill_chunk(cfg, params_t, pool, toks, chunk_len,
+                                  prefix_len, prefix_table, page_map):
+                p, stk = params_t
+                return pp.paged_pp_prefill_chunk(
+                    cfg, p, pool, toks, chunk_len, prefix_len,
+                    prefix_table, page_map, pp_mesh, pp_stage_axis, stk)
+
             self._prefill = None     # PP admits through the batched path
+            # ... except prefix-cache HITS, which admit singly through the
+            # pipelined chunked prefill (each stage reuses its own layers'
+            # cached prefix pages)
             self._prefill_batch = jax.jit(_pp_prefill_batch, static_argnums=0,
+                                          donate_argnums=donate)
+            self._prefill_chunk = jax.jit(_pp_prefill_chunk, static_argnums=0,
                                           donate_argnums=donate)
         elif cp_mesh is not None:
             # composed CP×TP names "model" so the ring/all-to-all runs per
@@ -1028,9 +1064,10 @@ class PagedInferenceEngine(EngineBase):
                                   ep_mesh=ep_mesh, flash_mesh=flash_mesh,
                                   sp_mesh=tp_mesh if sp else None),
                 static_argnums=0, donate_argnums=donate)
-        self._prefill_chunk = jax.jit(
-            functools.partial(paged_prefill_chunk, ep_mesh=ep_mesh),
-            static_argnums=0, donate_argnums=donate)
+        if pp_mesh is None:
+            self._prefill_chunk = jax.jit(
+                functools.partial(paged_prefill_chunk, ep_mesh=ep_mesh),
+                static_argnums=0, donate_argnums=donate)
         self._decode = jax.jit(
             pp_decode_fn if pp_decode_fn is not None
             else functools.partial(paged_decode_step, ep_mesh=ep_mesh),
@@ -1076,10 +1113,12 @@ class PagedInferenceEngine(EngineBase):
         while self._pending and self._free_slots:
             group, matched = self._admission_group()
             try:
-                # PP has no single-sequence prefill: every admission goes
+                # PP has no single-sequence FULL prefill: admissions go
                 # through the batched pipelined path (padded to a
-                # microbatch multiple in _admit_batch)
-                if len(group) == 1 and not self._pp:
+                # microbatch multiple in _admit_batch) — except prefix-
+                # cache HITS, which _admit routes through the pipelined
+                # chunked prefill (prefix KV reuse per stage)
+                if len(group) == 1 and (not self._pp or matched[1]):
                     early = self._admit(group[0], matched)
                     admitted = [early] if early is not None else []
                 else:

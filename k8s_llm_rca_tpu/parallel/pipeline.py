@@ -896,3 +896,109 @@ def paged_pp_decode_multi(cfg, params, pool, tokens, lengths, block_tables,
 
     logits = L._logits(cfg, params, out.reshape(b, t, h_dim))   # [B, T, V]
     return (_rebuild(pool, kv_out), jnp.argmax(logits, axis=-1), logits)
+
+
+def paged_pp_prefill_chunk(cfg, params, pool, tokens, chunk_len,
+                           prefix_len, prefix_table, page_map, mesh: Mesh,
+                           stage_axis: str = "stage", stacked_layers=None):
+    """Pipeline-parallel CHUNKED prefix prefill: the prefix-cache hit
+    path under PP serving.  Prefills the non-cached SUFFIX of one prompt
+    whose first ``prefix_len`` tokens' KV already sit in pool pages —
+    same contract as ``paged.paged_prefill_chunk`` — with each stage
+    gathering its OWN layers' cached prefix pages from its local pool
+    slice and scattering its chunk KV back (the pool's layer axis is
+    stage-sharded).  One sequence, so the GPipe schedule degenerates to
+    m=1 (sequential stages, no overlap) — the win here is the prefix KV
+    REUSE, not pipelining.  PP-only (no tp/ep composition: the chunk
+    path is per-sequence and the engines reject prefix_cache under the
+    composed meshes)."""
+    from k8s_llm_rca_tpu.engine.paged import _chunk_layer, _pool_packed
+    from k8s_llm_rca_tpu.models import llama as L
+
+    n_stages = mesh.shape[stage_axis]
+    _, c_pad = tokens.shape
+    page_size = pool.page_size
+    assert c_pad % page_size == 0, (c_pad, page_size)
+    n_chunk_pages = c_pad // page_size
+    assert cfg.n_layers % n_stages == 0
+    stacked = (stacked_layers if stacked_layers is not None
+               else stack_llama_stages(params, n_stages))
+    quant = pool.quantized
+    packed = quant and _pool_packed(cfg, pool)
+    s_prefix = prefix_table.shape[0] * page_size
+    dtype = jnp.dtype(cfg.dtype)
+
+    angles = L.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = prefix_len + jnp.arange(c_pad)[None, :]          # [1, C]
+    # causal + validity mask in absolute positions (paged_prefill_chunk)
+    q_pos = prefix_len + jnp.arange(c_pad)                       # [C]
+    k_abs = jnp.concatenate([jnp.arange(s_prefix), q_pos])       # [S]
+    k_valid = jnp.concatenate([
+        jnp.arange(s_prefix) < prefix_len,
+        jnp.arange(c_pad) < chunk_len,
+    ])
+    mask = (q_pos[:, None] >= k_abs[None, :]) & k_valid[None, :]  # [C, S]
+    x = L.gather_rows(params["embedding"], tokens).astype(dtype)  # [1, C, H]
+    h_dim = x.shape[-1]
+    x_mb = x.reshape(1, 1, c_pad, h_dim)
+    pages = page_map.reshape(1, n_chunk_pages)
+
+    def local(stage_layers, kv, x_mb, mask, positions, prefix_tbl, pages):
+        n_st, my, layers, perm = _stage_local_init(stage_layers, stage_axis)
+        pages1 = pages[0]                                 # [n_chunk_pages]
+
+        def stage_apply(h, mb_idx, valid, kv):
+            def body(carry, xs):
+                layer, k_li, v_li = xs[0], xs[1], xs[2]
+                ks_li = vs_li = None
+                if quant:
+                    ks_li, vs_li = xs[3], xs[4]
+                # shared per-layer chunk block (engine/paged._chunk_layer):
+                # gather cached prefix, attend, finish — identical to the
+                # plain path; only the page WRITE below is PP-specific
+                x2, k, v = _chunk_layer(cfg, layer, carry, angles,
+                                        positions, mask, k_li, v_li,
+                                        ks_li, vs_li, prefix_tbl, dtype,
+                                        packed)
+                # scatter the chunk's KV into its new pages (valid-masked)
+                k_new = k[0].reshape(c_pad, cfg.kv_dim)
+                v_new = v[0].reshape(c_pad, cfg.kv_dim)
+                if quant:
+                    k_new, ks = L._quantize_kv(k_new, packed)
+                    v_new, vs = L._quantize_kv(v_new, packed)
+                    ks = ks.reshape(n_chunk_pages, page_size)
+                    vs = vs.reshape(n_chunk_pages, page_size)
+                    ks_li = ks_li.at[pages1].set(
+                        jnp.where(valid, ks, ks_li[pages1]))
+                    vs_li = vs_li.at[pages1].set(
+                        jnp.where(valid, vs, vs_li[pages1]))
+                k_new = k_new.reshape(n_chunk_pages, page_size, -1)
+                v_new = v_new.reshape(n_chunk_pages, page_size, -1)
+                k_li = k_li.at[pages1].set(
+                    jnp.where(valid, k_new.astype(k_li.dtype),
+                              k_li[pages1]))
+                v_li = v_li.at[pages1].set(
+                    jnp.where(valid, v_new.astype(v_li.dtype),
+                              v_li[pages1]))
+                return x2, ((k_li, v_li, ks_li, vs_li) if quant
+                            else (k_li, v_li))
+
+            h, kv = jax.lax.scan(body, h, (layers, *kv))
+            return h, kv
+
+        return _gpipe_loop(stage_apply, x_mb, kv, 1, n_st, my, perm,
+                           stage_axis)
+
+    out, kv_out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), _kv_specs(quant, None, stage_axis),
+                  P(*(None,) * 4), P(None, None), P(None, None), P(None),
+                  P(None, None)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, None, stage_axis)),
+        check_vma=False,
+    )(stacked, _kv_tuple(pool), x_mb, mask, positions, prefix_table, pages)
+
+    x_final = out.reshape(1, c_pad, h_dim)
+    last = jax.lax.dynamic_slice_in_dim(x_final, chunk_len - 1, 1, axis=1)
+    logits = L._logits(cfg, params, last)[:, 0]                  # [1, V]
+    return _rebuild(pool, kv_out), logits

@@ -1361,6 +1361,50 @@ def test_pp_paged_engine_matches_plain(cpu_devices, kv_dtype):
     eng.allocator.check()                      # no pages leaked under PP
 
 
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_pp_paged_prefix_cache_reuse(cpu_devices, kv_dtype):
+    """Prefix caching composes with (stage-only) PP: a repeated prompt's
+    second admission routes through the PIPELINED chunked prefix prefill
+    — each stage reuses its own layers' cached prefix pages from its
+    local pool slice — with greedy output identical to the plain paged
+    prefix engine and real page-level KV reuse (prefix_hit_tokens),
+    including the quantized pool (scale gather + scale scatter in the
+    pipelined chunk body)."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.logging import METRICS
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    mesh = build_mesh(MeshConfig(stage=2), devices=cpu_devices[:2])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=64, prefill_buckets=(16, 32),
+                        max_new_tokens=6, temperature=0.0,
+                        prefix_cache=True, decode_chunk=1,
+                        kv_cache_dtype=kv_dtype)
+    prompt = tok.encode("incident pod crashloop in namespace prod",
+                        add_bos=True)
+    assert len(prompt) > 16            # spans >2 pages -> cacheable prefix
+
+    with jax.default_matmul_precision("float32"):
+        plain = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                     use_kernel=False)
+        p1 = plain.generate([list(prompt)], max_new_tokens=6)[0]
+        eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                   use_kernel=False, pp_mesh=mesh)
+        r1 = eng.generate([list(prompt)], max_new_tokens=6)[0]
+        before = METRICS.count("engine.prefix_hit_tokens")
+        r2 = eng.generate([list(prompt)], max_new_tokens=6)[0]
+    assert r1.token_ids == p1.token_ids
+    assert r2.token_ids == r1.token_ids
+    # the second admission actually REUSED cached prefix KV through the
+    # pipelined chunk path
+    assert METRICS.count("engine.prefix_hit_tokens") > before, kv_dtype
+    eng.allocator.check()
+
+
 def test_pp_engine_dfa_scan_parity(cpu_devices):
     """Grammar-constrained decode stays on the fast path under PP: the
     DFA rides inside the chunked scan whose body is the PIPELINED decode
@@ -1744,10 +1788,15 @@ def test_pp_mesh_validation(cpu_devices):
         make_engine(cfg, EngineConfig(**base), params, tok, pp_mesh=pp,
                     pp_microbatches=3)
     with pytest.raises(ValueError, match="prefix_cache"):
+        # prefix caching composes with stage-only PP (see
+        # test_pp_paged_prefix_cache_reuse) but not with the composed
+        # meshes — the chunked prefix prefill is per-sequence
+        pptp = build_mesh(MeshConfig(stage=2, model=2),
+                          devices=cpu_devices[:4])
         PagedInferenceEngine(
             cfg, EngineConfig(paged=True, page_size=16, num_pages=32,
                               prefix_cache=True, **base),
-            params, tok, pp_mesh=pp)
+            params, tok, pp_mesh=pptp, tp_mesh=pptp, use_kernel=False)
     with pytest.raises(ValueError, match="use_kernel"):
         PagedInferenceEngine(
             cfg, EngineConfig(paged=True, page_size=16, num_pages=32,
