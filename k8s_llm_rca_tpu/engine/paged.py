@@ -186,7 +186,10 @@ class PartitionedPageAllocator(PageAllocator):
     def n_free(self) -> int:
         return sum(len(p) for p in self._free_parts)
 
-    def alloc(self, n: int, owner: int, part: int = 0) -> List[int]:
+    def alloc(self, n: int, owner: int, *, part: int) -> List[int]:
+        # ``part`` is REQUIRED (no default): a partition-blind caller
+        # falling through to the base-class signature would silently drain
+        # partition 0, a misalignment check() cannot detect
         free = self._free_parts[part]
         if n > len(free):
             raise OutOfPages(

@@ -146,10 +146,16 @@ def build_rows(pairs: Sequence[Transcript], tokenizer,
         mask += [0] * (seq_len - len(mask))
         rows.append(row)
         masks.append(mask)
-    # dedupe identical rows (repeated seeds/acks across incidents)
+    # dedupe identical (row, mask) pairs (repeated seeds/acks across
+    # incidents) — keyed on BOTH so two transcripts rendering to the same
+    # padded tokens with different prompt/target boundaries keep their
+    # distinct supervision splits
     uniq = {}
     for r, m in zip(rows, masks):
-        uniq[tuple(r)] = (r, m)
+        uniq[(tuple(r), tuple(m))] = (r, m)
+    if not uniq:
+        raise ValueError("no transcripts to build rows from (every "
+                         "incident was filtered out upstream)")
     rows, masks = zip(*uniq.values())
     return (np.asarray(rows, np.int32), np.asarray(masks, np.int32))
 

@@ -102,8 +102,14 @@ def scan_output(output_path: str, truncate_partial: bool = False):
     if truncate_partial and len(text.rstrip()) > end:
         log.warning("truncating partial tail record in %s (crash artifact)",
                     output_path)
-        with open(output_path, "w") as f:
+        # atomic: a crash between truncate and rewrite must not lose the
+        # completed records, so write a sibling temp file and rename over
+        tmp = output_path + ".tmp"
+        with open(tmp, "w") as f:
             f.write(text[:end] + ("\n" if end else ""))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, output_path)
     return msgs, end
 
 
