@@ -48,7 +48,8 @@ from k8s_llm_rca_tpu.models.llama import _quantize_kv
 from k8s_llm_rca_tpu.ops.attention import decode_attention
 from k8s_llm_rca_tpu.ops.norms import rms_norm
 from k8s_llm_rca_tpu.ops.paged_attention import (
-    paged_attention, paged_attention_quant, paged_attention_xla,
+    paged_attention, paged_attention_quant, paged_attention_quant_sharded,
+    paged_attention_sharded, paged_attention_xla,
 )
 from k8s_llm_rca_tpu.engine.prefix import PrefixCache
 from k8s_llm_rca_tpu.ops.rope import rope_frequencies
@@ -510,7 +511,8 @@ def paged_prefill_chunk(cfg: ModelConfig, params, pool: PagePool,
 def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
                       tokens: jnp.ndarray, lengths: jnp.ndarray,
                       block_tables: jnp.ndarray, *,
-                      use_kernel: Optional[bool] = None, ep_mesh=None):
+                      use_kernel: Optional[bool] = None, ep_mesh=None,
+                      tp_mesh=None):
     """One decode step for all sequences over the paged pool.
 
     tokens [B]; lengths [B] tokens already cached; block_tables
@@ -520,7 +522,10 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
 
     Quantized pools use the quantized Pallas kernel on TPU (int8 or
     nibble-packed int4 pages + per-token scale rows) and a gather+dequant
-    XLA path elsewhere.
+    XLA path elsewhere.  ``tp_mesh``: run the kernel PER HEAD SHARD over
+    the mesh's "model" axis (ops.paged_attention_sharded) — the engine
+    passes it only for configs the shard_map wrapper supports (whole GQA
+    groups per shard, unpacked pool, no CP).
     """
     b = tokens.shape[0]
     page_size = pool.page_size
@@ -536,8 +541,18 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
     offsets = lengths % page_size                             # [B]
 
     kernel_on = use_kernel or (use_kernel is None
-                               and jax.default_backend() == "tpu")
-    attn_fn = paged_attention if kernel_on else paged_attention_xla
+                               and jax.default_backend() == "tpu"
+                               and tp_mesh is None)
+    if kernel_on and tp_mesh is not None and packed:
+        raise ValueError("packed int4 pools cannot run the sharded kernel "
+                         "(split-half packing vs head shard); the engine "
+                         "gating should have routed this to XLA")
+    if kernel_on and tp_mesh is not None:
+        attn_fn = functools.partial(paged_attention_sharded, mesh=tp_mesh)
+    elif kernel_on:
+        attn_fn = paged_attention
+    else:
+        attn_fn = paged_attention_xla
 
     k_scale, v_scale = pool.k_scale, pool.v_scale
     for li, layer in enumerate(params["layers"]):
@@ -557,7 +572,11 @@ def paged_decode_step(cfg: ModelConfig, params, pool: PagePool,
         vp = pool.v[li].at[page_ids, offsets].set(v_tok)
         pool = PagePool(pool.k.at[li].set(kp), pool.v.at[li].set(vp),
                         k_scale, v_scale)
-        if pool.quantized and kernel_on:
+        if pool.quantized and kernel_on and tp_mesh is not None:
+            attn = paged_attention_quant_sharded(
+                q[:, 0], kp, vp, k_scale[li], v_scale[li], lengths + 1,
+                block_tables, tp_mesh)
+        elif pool.quantized and kernel_on:
             attn = paged_attention_quant(
                 q[:, 0], kp, vp, k_scale[li], v_scale[li], lengths + 1,
                 block_tables, packed=packed)
@@ -643,7 +662,7 @@ def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
                       block_tables: jnp.ndarray, key, n_steps: int,
                       sampling: SamplingParams, eos_id: int,
                       use_kernel: Optional[bool] = None, ep_mesh=None,
-                      decode_fn=None):
+                      tp_mesh=None, decode_fn=None):
     """``n_steps`` paged decode steps with zero host sync (the paged
     engine's chunked tick).  ``block_tables`` stays static for the whole
     scan; each per-step write indexes it dynamically (lengths // page),
@@ -663,7 +682,8 @@ def paged_decode_scan(cfg: ModelConfig, params, pool: PagePool,
             pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
                                              block_tables,
                                              use_kernel=use_kernel,
-                                             ep_mesh=ep_mesh)
+                                             ep_mesh=ep_mesh,
+                                             tp_mesh=tp_mesh)
         else:
             pool, logits = decode_fn(cfg, params, pool, cur, lens,
                                      block_tables)
@@ -691,7 +711,7 @@ def paged_decode_scan_dfa(cfg: ModelConfig, params, pool: PagePool,
                           dist_t: jnp.ndarray, close_t: jnp.ndarray,
                           complete_t: jnp.ndarray,
                           use_kernel: Optional[bool] = None, ep_mesh=None,
-                          decode_fn=None):
+                          tp_mesh=None, decode_fn=None):
     """``paged_decode_scan`` with the compiled grammar DFA riding inside
     the scan (mirrors engine.decode_scan_dfa: budget-aware mask, sample,
     state transition — all gathers on device).  Returns
@@ -705,7 +725,8 @@ def paged_decode_scan_dfa(cfg: ModelConfig, params, pool: PagePool,
             pool, logits = paged_decode_step(cfg, params, pool, cur, lens,
                                              block_tables,
                                              use_kernel=use_kernel,
-                                             ep_mesh=ep_mesh)
+                                             ep_mesh=ep_mesh,
+                                             tp_mesh=tp_mesh)
         else:
             pool, logits = decode_fn(cfg, params, pool, cur, lens,
                                      block_tables)
@@ -799,15 +820,36 @@ class PagedInferenceEngine(EngineBase):
                     "use_kernel=True is incompatible with pp_mesh (the "
                     "pipelined decode reads the gathered XLA page view)")
             use_kernel = False
-        if use_kernel and (tp_mesh is not None or params_multi_device(params)):
-            # pallas_call has no SPMD partitioning rule: the paged kernel
-            # would silently replicate per-device instead of sharding
-            raise ValueError("use_kernel=True is incompatible with sharded "
-                             "params / tp_mesh (no SPMD rule for Pallas); "
-                             "the XLA paged-attention path shards correctly")
-        if use_kernel is None and (tp_mesh is not None
-                                   or params_multi_device(params)):
-            use_kernel = False
+        # Pallas has no SPMD partitioning rule, so a sharded config can
+        # only run the kernel PER HEAD SHARD via shard_map
+        # (ops.paged_attention_sharded, the flash_attention_sharded
+        # pattern).  That needs: the TP mesh itself, whole GQA groups per
+        # shard, a page axis that is NOT seq-sharded (CP pools distribute
+        # pages across devices), and an unpacked pool (int4's split-half
+        # nibble packing does not commute with the head shard).
+        self._kernel_mesh = None
+        if (tp_mesh is not None or cp_mesh is not None
+                or params_multi_device(params)):
+            n_tp = tp_mesh.shape["model"] if tp_mesh is not None else 0
+            sharded_ok = (tp_mesh is not None and cp_mesh is None
+                          and n_tp > 0
+                          and model_cfg.n_heads % n_tp == 0
+                          and model_cfg.n_kv_heads % n_tp == 0
+                          and engine_cfg.kv_cache_dtype != "int4")
+            if use_kernel and not sharded_ok:
+                raise ValueError(
+                    "use_kernel=True under sharding requires a tp_mesh "
+                    "with n_heads/n_kv_heads divisible by its 'model' "
+                    "axis, no cp_mesh (the CP pool's page axis is "
+                    "seq-sharded), and kv_cache_dtype != 'int4' (nibble "
+                    "packing does not commute with the head shard); pass "
+                    "use_kernel=None/False to serve this config on the "
+                    "XLA paged-attention path")
+            if use_kernel is None:
+                use_kernel = bool(sharded_ok
+                                  and jax.default_backend() == "tpu")
+            if use_kernel:
+                self._kernel_mesh = tp_mesh
         self._cp_parts = 0
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
@@ -1073,17 +1115,20 @@ class PagedInferenceEngine(EngineBase):
                 static_argnums=0, donate_argnums=donate)
         self._decode = jax.jit(
             pp_decode_fn if pp_decode_fn is not None
-            else functools.partial(paged_decode_step, ep_mesh=ep_mesh),
+            else functools.partial(paged_decode_step, ep_mesh=ep_mesh,
+                                    tp_mesh=self._kernel_mesh),
             static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_scan = jax.jit(
             functools.partial(paged_decode_scan, ep_mesh=ep_mesh,
+                              tp_mesh=self._kernel_mesh,
                               decode_fn=pp_decode_fn),
             static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))
         self._dfa_scan = True
         self._decode_scan_dfa = jax.jit(
             functools.partial(paged_decode_scan_dfa, ep_mesh=ep_mesh,
+                              tp_mesh=self._kernel_mesh,
                               decode_fn=pp_decode_fn),
             static_argnums=(0, 7, 8, 9),
             donate_argnums=donate, static_argnames=("use_kernel",))

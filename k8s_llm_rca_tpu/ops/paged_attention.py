@@ -368,6 +368,106 @@ def paged_attention_quant(
     return _extract_block_diag(out, n_kv, d)
 
 
+def _validate_head_shard(n_heads: int, n_kv: int, n_tp: int) -> None:
+    if n_heads % n_tp or n_kv % n_tp:
+        raise ValueError(
+            f"paged attention under TP needs n_heads={n_heads} and "
+            f"n_kv={n_kv} divisible by the head axis size {n_tp} "
+            f"(GQA groups must stay whole per shard)")
+
+
+def paged_attention_sharded(
+    q: jnp.ndarray,             # [B, n_heads, d]
+    k_pages: jnp.ndarray,       # [n_pages, page_size, n_kv*d]
+    v_pages: jnp.ndarray,       # [n_pages, page_size, n_kv*d]
+    lengths: jnp.ndarray,       # [B] int32
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    mesh,
+    head_axis: str = "model",
+    **kw,
+) -> jnp.ndarray:
+    """``paged_attention`` under tensor parallelism.
+
+    ``pallas_call`` has no SPMD partitioning rule, so calling the kernel
+    on a TP-sharded pool would silently replicate full attention on every
+    device (the reason the paged engine used to concede sharded decode to
+    the XLA gather).  Same fix as ops.flash_attention_sharded: heads are
+    independent, so each device runs the kernel over ITS kv-head shard —
+    q enters head-sharded over ``head_axis`` and the pool enters sharded
+    on its merged kv lane axis (their natural layouts under
+    column-parallel wq/wk/wv and the engine's
+    ``P(None, None, None, "model")`` pool placement, so no resharding at
+    the boundary).  GQA grouping is preserved per shard: both head counts
+    must divide the axis.  Batch stays unsharded, matching the decode
+    activations (replicated across the TP group).
+    """
+    _validate_head_shard(q.shape[1], k_pages.shape[-1] // q.shape[-1],
+                         mesh.shape[head_axis])
+
+    def local(q, kp, vp, lens, bt):
+        return paged_attention(q, kp, vp, lens, bt, **kw)
+
+    q_spec = jax.sharding.PartitionSpec(None, head_axis, None)
+    pool_spec = jax.sharding.PartitionSpec(None, None, head_axis)
+    vec = jax.sharding.PartitionSpec(None)
+    bt_spec = jax.sharding.PartitionSpec(None, None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(q_spec, pool_spec, pool_spec, vec, bt_spec),
+        out_specs=q_spec, check_vma=False,
+    )(q, k_pages, v_pages, lengths, block_tables)
+
+
+def paged_attention_quant_sharded(
+    q: jnp.ndarray,             # [B, n_heads, d]
+    k_pages: jnp.ndarray,       # [n_pages, page_size, n_kv*d] int8
+    v_pages: jnp.ndarray,       # [n_pages, page_size, n_kv*d] int8
+    k_scales: jnp.ndarray,      # [n_pages, page_size]
+    v_scales: jnp.ndarray,      # [n_pages, page_size]
+    lengths: jnp.ndarray,       # [B] int32
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    mesh,
+    head_axis: str = "model",
+    **kw,
+) -> jnp.ndarray:
+    """``paged_attention_quant`` under tensor parallelism (int8 pools).
+
+    The per-token scale is a FULL-ROW scalar (one per written token,
+    recovered by pmax over the TP group at write time), so the scale
+    pools replicate across ``head_axis`` and each shard's dequant
+    ``int8 * scale`` is exact — per-shard attention then matches the
+    global computation bit-for-bit up to the reduction order.
+
+    Split-half nibble-packed int4 pools are NOT supported here: packing
+    pairs lane i with lane i + kv_dim/2, so a contiguous shard of the
+    PACKED lane axis unpacks to two non-contiguous head ranges — the
+    shard-local unpack would attend the wrong heads.  The engine keeps
+    int4 pools on the XLA gather path under TP (engine/paged.py gating).
+    """
+    if kw.pop("packed", False):
+        raise ValueError(
+            "paged_attention_quant_sharded does not support packed int4 "
+            "pools (split-half packing does not commute with the head "
+            "shard); use the XLA path")
+    _validate_head_shard(q.shape[1], k_pages.shape[-1] // q.shape[-1],
+                         mesh.shape[head_axis])
+
+    def local(q, kp, vp, ks, vs, lens, bt):
+        return paged_attention_quant(q, kp, vp, ks, vs, lens, bt,
+                                     packed=False, **kw)
+
+    q_spec = jax.sharding.PartitionSpec(None, head_axis, None)
+    pool_spec = jax.sharding.PartitionSpec(None, None, head_axis)
+    scale_spec = jax.sharding.PartitionSpec(None, None)
+    vec = jax.sharding.PartitionSpec(None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(q_spec, pool_spec, pool_spec, scale_spec, scale_spec,
+                  vec, scale_spec),
+        out_specs=q_spec, check_vma=False,
+    )(q, k_pages, v_pages, k_scales, v_scales, lengths, block_tables)
+
+
 def paged_attention_xla(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,

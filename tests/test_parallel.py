@@ -588,7 +588,83 @@ def test_paged_tp_engine_quantized_pool(cpu_devices, kv_dtype):
     eng.allocator.check()
 
 
-def test_paged_tp_rejects_kernel(cpu_devices):
+@pytest.mark.parametrize("use_kernel", [True, False])
+def test_paged_tp_kernel_matches_unsharded(cpu_devices, use_kernel):
+    """The paged-attention KERNEL under TP (VERDICT r4 item 3): decode
+    runs ops.paged_attention_sharded — the Pallas kernel per head shard
+    inside shard_map — and emits exactly the plain paged engine's greedy
+    tokens.  Parametrized against the XLA path so a silent fallback
+    cannot fake the parity."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                        page_size=8, num_pages=32,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0, decode_chunk=4)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("pod pending unschedulable", add_bos=True),
+               tok.encode("pvc not bound", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok, use_kernel=False).generate(
+        prompts, max_new_tokens=6)
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    eng = make_engine(cfg, ecfg, sharded, tok, tp_mesh=mesh,
+                      use_kernel=use_kernel)
+    assert (eng._kernel_mesh is mesh) == use_kernel
+    got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+        assert r.finish_reason == g.finish_reason
+    eng.allocator.check()
+
+
+def test_paged_tp_kernel_int8_pool_matches_unsharded(cpu_devices):
+    """TP x int8 pool x kernel: paged_attention_quant_sharded (per-shard
+    quantized kernel, replicated full-row scales) matches the unsharded
+    quantized engine's greedy tokens."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                        page_size=8, num_pages=32,
+                        prefill_buckets=(16, 32, 64), max_new_tokens=6,
+                        temperature=0.0, kv_cache_dtype="int8",
+                        decode_chunk=4)
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    prompts = [tok.encode("node notready kubelet stopped", add_bos=True),
+               tok.encode("image pull backoff", add_bos=True)]
+
+    ref = make_engine(cfg, ecfg, params, tok, use_kernel=False).generate(
+        prompts, max_new_tokens=6)
+    sharded = shard_pytree(params, llama_param_specs(cfg), mesh)
+    eng = make_engine(cfg, ecfg, sharded, tok, tp_mesh=mesh,
+                      use_kernel=True)
+    assert eng._kernel_mesh is mesh
+    got = eng.generate(prompts, max_new_tokens=6)
+    for r, g in zip(ref, got):
+        assert r.token_ids == g.token_ids
+    eng.allocator.check()
+
+
+def test_paged_tp_rejects_kernel_unsupported_configs(cpu_devices):
+    """The sharded kernel's remaining exclusions stay loud: packed-int4
+    pools (split-half packing vs head shard), indivisible kv heads, and
+    CP seq-sharded pools all reject use_kernel=True."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
     from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
@@ -596,11 +672,30 @@ def test_paged_tp_rejects_kernel(cpu_devices):
     cfg = TINY.replace(max_seq_len=64)
     mesh = build_mesh(MeshConfig(data=2, model=2), devices=cpu_devices[:4])
     ecfg = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
-                        page_size=8, num_pages=32, prefill_buckets=(16,))
+                        page_size=8, num_pages=32, prefill_buckets=(16,),
+                        kv_cache_dtype="int4")
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="Pallas"):
+    with pytest.raises(ValueError, match="int4"):
         PagedInferenceEngine(cfg, ecfg, params, get_tokenizer(),
                              use_kernel=True, tp_mesh=mesh)
+    # indivisible kv heads: 2 kv heads cannot split over model=4
+    mesh4 = build_mesh(MeshConfig(data=2, model=4),
+                       devices=cpu_devices[:8])
+    ecfg8 = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                         page_size=8, num_pages=32, prefill_buckets=(16,))
+    with pytest.raises(ValueError, match="divisible"):
+        PagedInferenceEngine(cfg, ecfg8, params, get_tokenizer(),
+                             use_kernel=True, tp_mesh=mesh4)
+    # CP seq-sharded pool: pages are distributed across the seq axis,
+    # which the per-head-shard kernel cannot express — even with
+    # unsharded (host) params the mesh alone must reject the kernel
+    seq_mesh = build_mesh(MeshConfig(seq=2), devices=cpu_devices[:2])
+    ecfg_cp = EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                           page_size=8, num_pages=32,
+                           prefill_buckets=(16,), prefix_cache=False)
+    with pytest.raises(ValueError, match="cp_mesh"):
+        PagedInferenceEngine(cfg, ecfg_cp, params, get_tokenizer(),
+                             use_kernel=True, cp_mesh=seq_mesh)
 
 
 def test_contiguous_tp_engine_cache_sharded(cpu_devices):
