@@ -202,8 +202,11 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
     engines: the per-token scale is the full-row scale recovered by pmax
     over the TP group (llama._quantize_kv axis_name), so scale caches
     replicate across TP and numerics match the plain quantized paths
-    exactly.  PP×TP still requires unquantized WEIGHTS (the shard_map
-    spec tree matches plain tensors).
+    exactly.  Quantized WEIGHTS compose too: int8 payloads shard on the
+    weight spec with per-channel scales replicating their reduced dims,
+    and int4 payloads are re-packed per shard at the sharding boundary
+    ("shard first, pack second") so the stage bodies' shard-local
+    dequant is exact — see pipeline.shard_stacked_layers.
 
     PP composes with EP on ONE mesh carrying "stage" and "expert"
     (Mixtral across pods: stages over DCN, expert dispatch over ICI
@@ -255,19 +258,25 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
             # int8 (QuantTensor) composes: the stacked spec tree expands
             # per-leaf so payloads shard on the weight spec and
             # per-channel scales replicate their reduced dims
-            # (pipeline._stacked_in_specs).  int4 does NOT: the split-half
-            # nibble packing interleaves column pairs along the packed
-            # axis, so manually column-sharding it would pair each
-            # device's unpacked columns with the WRONG contiguous scale
-            # block.
+            # (pipeline._stacked_in_specs).  int4 composes by PER-SHARD
+            # packing: shard_stacked_layers re-packs every column-sharded
+            # QuantTensor4 so each TP shard is a self-contained
+            # split-half buffer ("shard first, pack second",
+            # quant.repack_nibbles_grouped) — which needs every sharded
+            # channel dim divisible by 2*n_tp.
             if any(isinstance(leaf, QuantTensor4)
                    for leaf in jax.tree.leaves(
                        params, is_leaf=lambda x: isinstance(
                            x, QuantTensor4))):
-                raise ValueError(
-                    "PP×TP requires int8 or unquantized weights: int4's "
-                    "split-half nibble packing does not commute with "
-                    "manual column sharding of the packed axis")
+                for dim, what in ((model_cfg.q_dim, "q_dim"),
+                                  (model_cfg.kv_dim, "kv_dim"),
+                                  (model_cfg.intermediate_size,
+                                   "intermediate_size")):
+                    if dim % (2 * n_tp):
+                        raise ValueError(
+                            f"PP×TP with int4 weights needs {what}={dim} "
+                            f"divisible by 2*model axis={2 * n_tp} "
+                            f"(per-shard split-half nibble packing)")
         if model_cfg.n_experts > 0:
             raise ValueError(
                 "PP×TP does not support MoE models (the manual-TP stage "

@@ -120,6 +120,43 @@ def quantize(w: jnp.ndarray, axis=-1,
                        scale=scale.astype(compute_dtype))
 
 
+def repack_nibbles_grouped(w: QuantTensor4, groups: int) -> QuantTensor4:
+    """Re-pack a split-half ``QuantTensor4`` so each of ``groups``
+    CONTIGUOUS column groups is split-half packed WITHIN the group.
+
+    This is the "shard first, pack second" layout that makes int4 commute
+    with manual column sharding (PP×TP stage bodies): after the packed
+    last axis is split into ``groups`` equal contiguous blocks, each
+    block is a self-contained split-half buffer of its own group's
+    columns, so a shard-local ``_unpack_nibbles`` (lo/hi concat) yields
+    exactly that shard's columns in order — and the per-column scale
+    shard is the matching contiguous block.  Global split-half packing
+    does NOT have this property: byte i pairs columns (i, i + C/2), so a
+    contiguous block of the packed axis unpacks to two disjoint column
+    ranges.
+
+    The result is only correct to consume SHARD-LOCALLY (inside a
+    shard_map whose spec splits the packed axis into exactly ``groups``
+    parts); a global ``dq()`` of a grouped-packed tensor interleaves
+    wrongly.  Engines therefore repack at the sharding boundary
+    (parallel/pipeline.shard_stacked_layers) and keep the plain layout
+    everywhere else.
+    """
+    if groups <= 1:
+        return w
+    c = w.shape[-1]                               # logical column count
+    if c % (2 * groups):
+        raise ValueError(
+            f"int4 per-shard packing needs the channel dim {c} divisible "
+            f"by 2*groups={2 * groups} (each shard packs its own "
+            f"split-half pairs)")
+    unpacked = _unpack_nibbles(w.q)               # int8 [..., C]
+    g = c // groups
+    grouped = unpacked.reshape(*unpacked.shape[:-1], groups, g)
+    packed = _pack_nibbles(grouped)               # [..., groups, g/2]
+    return QuantTensor4(q=packed.reshape(*w.q.shape), scale=w.scale)
+
+
 def dq(w: Any) -> jnp.ndarray:
     """Dequantize a QuantTensor/QuantTensor4; pass plain arrays through."""
     if isinstance(w, QuantTensor):

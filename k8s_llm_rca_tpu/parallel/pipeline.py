@@ -123,11 +123,31 @@ def shard_stacked_layers(stacked: Any, mesh: Mesh,
     int8-quantized leaves (``QuantTensor``) shard their payload on the
     weight spec and their per-channel scales with reduced (size-1) dims
     replicated — runtime.sharding.shard_pytree's placement rule.
+
+    int4 leaves (``QuantTensor4``) whose LAST axis shards over
+    ``tp_axis`` are first RE-PACKED per shard
+    (quant.repack_nibbles_grouped, "shard first, pack second"): each TP
+    shard of the packed axis becomes a self-contained split-half buffer
+    of its own columns, so the stage bodies' shard-local ``dq()`` is
+    correct by construction.  Row-sharded int4 leaves (wo/w_down) keep
+    the plain layout — packing is per-row independent.
     """
     if tp_axis is not None or ep_axis is not None:
         from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
 
         specs = stacked_layer_specs(cfg, stage_axis, tp_axis, ep_axis)
+        if tp_axis is not None:
+            from k8s_llm_rca_tpu.models.quant import (
+                QuantTensor4, repack_nibbles_grouped,
+            )
+
+            n_tp = mesh.shape[tp_axis]
+            stacked = {
+                k: (repack_nibbles_grouped(v, n_tp)
+                    if isinstance(v, QuantTensor4) and tuple(specs[k])
+                    and tuple(specs[k])[-1] == tp_axis else v)
+                for k, v in stacked.items()
+            }
         return shard_pytree(stacked, specs, mesh)
 
     def _put(x):
@@ -144,11 +164,14 @@ def _stacked_in_specs(stacked: Any, cfg, stage_axis: str,
     PP-only: the single prefix spec P(stage_axis) broadcasts over every
     leaf (including QuantTensor sub-leaves, whose q and scale both carry
     the leading stage dim).  Composed PP×TP / PP×EP: per-key specs, with
-    int8 ``QuantTensor`` leaves expanded to (q spec, scale spec) — the
-    scale takes the weight spec with its size-1 (reduced) dims
-    replicated, mirroring runtime.sharding.shard_pytree's placement so
-    the shard_map view matches where the bytes already live."""
-    from k8s_llm_rca_tpu.models.quant import QuantTensor
+    quantized leaves (``QuantTensor``/``QuantTensor4``) expanded to
+    (q spec, scale spec) — the scale takes the weight spec with its
+    size-1 (reduced) dims replicated, mirroring
+    runtime.sharding.shard_pytree's placement so the shard_map view
+    matches where the bytes already live.  For int4 the q spec applies
+    to the PACKED axis, which shard_stacked_layers re-packed per shard
+    so the local blocks are self-contained."""
+    from k8s_llm_rca_tpu.models.quant import QuantTensor, QuantTensor4
 
     if tp_axis is None and ep_axis is None:
         return P(stage_axis)
@@ -156,11 +179,11 @@ def _stacked_in_specs(stacked: Any, cfg, stage_axis: str,
     out = {}
     for k, v in stacked.items():
         spec = base[k]
-        if isinstance(v, QuantTensor):
+        if isinstance(v, (QuantTensor, QuantTensor4)):
             full = tuple(spec) + (None,) * (v.q.ndim - len(spec))
             scale_spec = P(*(s if d > 1 else None
                              for s, d in zip(full, v.scale.shape)))
-            out[k] = QuantTensor(q=P(*full), scale=scale_spec)
+            out[k] = type(v)(q=P(*full), scale=scale_spec)
         else:
             out[k] = spec
     return out

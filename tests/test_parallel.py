@@ -1765,13 +1765,16 @@ def test_pp_composed_speculative_matches_plain(cpu_devices):
 
 
 @pytest.mark.parametrize("paged", [False, True])
-def test_pp_tp_quantized_weights_matches_plain(cpu_devices, paged):
-    """int8 WEIGHTS compose with PP×TP (the quantized-flagship pod
+@pytest.mark.parametrize("bits", [8, 4])
+def test_pp_tp_quantized_weights_matches_plain(cpu_devices, paged, bits):
+    """Quantized WEIGHTS compose with PP×TP (the quantized-flagship pod
     serving shape): stacked QuantTensor leaves shard their payload on
     the weight spec and their per-channel scales with reduced dims
-    replicated, and the manual-TP stage bodies dequantize local shards
-    — exact greedy parity with the plain engine on the same quantized
-    params."""
+    replicated; int4 leaves are additionally RE-PACKED per shard at the
+    sharding boundary ("shard first, pack second") so the manual-TP
+    stage bodies' shard-local dequant is exact — greedy parity with the
+    plain engine on the same quantized params.  bits=4 runs the bench's
+    own flagship quant config (int4 weights + int4 KV)."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine import make_engine
     from k8s_llm_rca_tpu.models.quant import quantize_params
@@ -1782,14 +1785,16 @@ def test_pp_tp_quantized_weights_matches_plain(cpu_devices, paged):
                       devices=cpu_devices[:4])
     params = quantize_params(
         llama.init_params(cfg, jax.random.PRNGKey(0)),
-        compute_dtype=jnp.float32, bits=8)
+        compute_dtype=jnp.float32, bits=bits)
     tok = get_tokenizer(vocab_size=cfg.vocab_size)
     extra = (dict(paged=True, page_size=16, num_pages=32,
                   prefix_cache=False) if paged else {})
     kw = dict(use_kernel=False) if paged else {}
     ecfg = EngineConfig(max_batch=2, max_seq_len=64,
                         prefill_buckets=(16, 32), max_new_tokens=6,
-                        temperature=0.0, kv_cache_dtype="int8", **extra)
+                        temperature=0.0,
+                        kv_cache_dtype="int8" if bits == 8 else "int4",
+                        **extra)
     prompts = [tok.encode("pod crashloop kube-system", add_bos=True),
                tok.encode("node disk pressure taint", add_bos=True)]
     with jax.default_matmul_precision("float32"):
@@ -1810,11 +1815,10 @@ def test_pp_tp_quantized_weights_matches_plain(cpu_devices, paged):
 
 
 def test_pp_tp_exclusions(cpu_devices):
-    """PP×TP rejects loudly: distinct meshes, int4-PACKED weights (the
-    split-half nibble layout doesn't commute with manual column
-    sharding; int8 weights, quantized KV and the paged engine all
-    compose — see the parity tests above), MoE models, and Megatron
-    SP."""
+    """PP×TP rejects loudly: distinct meshes, int4 weights whose channel
+    dims don't divide 2*n_tp (per-shard split-half packing needs even
+    per-shard pairs; divisible int4 composes — see the parity tests
+    above), MoE models, and Megatron SP."""
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
     from k8s_llm_rca_tpu.engine import make_engine
     from k8s_llm_rca_tpu.models.quant import quantize_params
@@ -1830,15 +1834,21 @@ def test_pp_tp_exclusions(cpu_devices):
     ecfg = EngineConfig(max_batch=2, max_seq_len=64, prefill_buckets=(16,))
     with pytest.raises(ValueError, match="SAME composed mesh"):
         make_engine(cfg, ecfg, params, tok, pp_mesh=mesh, tp_mesh=mesh_b)
-    with pytest.raises(ValueError, match="int8 or unquantized"):
-        make_engine(cfg, ecfg, quantize_params(params, bits=4), tok,
+    # intermediate_size=250 is even (packable) but 250 % (2*n_tp)=4 != 0:
+    # the per-shard repack cannot split its column pairs evenly
+    odd_cfg = cfg.replace(intermediate_size=250)
+    odd_params = quantize_params(
+        llama.init_params(odd_cfg, jax.random.PRNGKey(2)), bits=4)
+    with pytest.raises(ValueError, match="per-shard split-half"):
+        make_engine(odd_cfg, ecfg, odd_params, tok,
                     pp_mesh=mesh, tp_mesh=mesh)
-    with pytest.raises(ValueError, match="int8 or unquantized"):
-        # the paged engine applies the same int4-weight rejection
-        make_engine(cfg, dataclasses.replace(ecfg, paged=True, page_size=16,
-                                             num_pages=16,
-                                             prefix_cache=False),
-                    quantize_params(params, bits=4), tok,
+    with pytest.raises(ValueError, match="per-shard split-half"):
+        # the paged engine applies the same divisibility rejection
+        make_engine(odd_cfg, dataclasses.replace(ecfg, paged=True,
+                                                 page_size=16,
+                                                 num_pages=16,
+                                                 prefix_cache=False),
+                    odd_params, tok,
                     pp_mesh=mesh, tp_mesh=mesh, use_kernel=False)
     with pytest.raises(ValueError, match="MoE"):
         moe_cfg = TINY_MOE.replace(n_layers=4, n_experts=4, max_seq_len=64)

@@ -272,3 +272,43 @@ def test_moe_experts_get_per_expert_scales():
     qp = quantize_params(params, compute_dtype=jnp.float32)
     gate = qp["layers"][0]["w_gate"]
     assert gate.scale.shape[0] == TINY_MOE.n_experts
+
+
+def test_repack_nibbles_grouped_shard_local_unpack():
+    """The "shard first, pack second" property that makes int4 compose
+    with PP×TP: after repacking into G groups, each contiguous 1/G block
+    of the PACKED axis is a self-contained split-half buffer whose local
+    unpack yields exactly that shard's logical columns (with the
+    matching contiguous scale block) — for every group count dividing
+    the column pairs."""
+    from k8s_llm_rca_tpu.models.quant import (
+        _unpack_nibbles, quantize, repack_nibbles_grouped,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(3), (16, 24), jnp.float32)
+    qt = quantize(w, axis=-1, compute_dtype=jnp.float32, bits=4)
+    full = np.asarray(dq(qt))                       # global dequant [16, 24]
+    for groups in (1, 2, 3, 4, 6):
+        rp = repack_nibbles_grouped(qt, groups)
+        assert rp.q.shape == qt.q.shape
+        packed_w = qt.q.shape[-1] // groups         # packed cols per shard
+        logical_w = 24 // groups
+        for g in range(groups):
+            q_shard = rp.q[:, g * packed_w:(g + 1) * packed_w]
+            s_shard = np.asarray(
+                qt.scale[:, g * logical_w:(g + 1) * logical_w])
+            local = np.asarray(_unpack_nibbles(q_shard)).astype(np.float32)
+            np.testing.assert_array_equal(
+                local * s_shard,
+                full[:, g * logical_w:(g + 1) * logical_w])
+
+
+def test_repack_nibbles_grouped_rejects_odd_pairs():
+    import pytest
+
+    from k8s_llm_rca_tpu.models.quant import quantize, repack_nibbles_grouped
+
+    w = jax.random.normal(jax.random.PRNGKey(4), (4, 10), jnp.float32)
+    qt = quantize(w, axis=-1, bits=4)
+    with pytest.raises(ValueError, match="divisible"):
+        repack_nibbles_grouped(qt, 3)               # 10 % (2*3) != 0
