@@ -425,31 +425,52 @@ def paged_prefill_cp(cfg: ModelConfig, params, pool: PagePool,
 
 def _chunk_layer(cfg: ModelConfig, layer, x, angles, positions, mask,
                  k_pages, v_pages, k_scales, v_scales, prefix_table,
-                 dtype, packed: bool, ep_mesh=None):
+                 dtype, packed: bool, ep_mesh=None, tp_axis=None):
     """One transformer layer of chunked prefix prefill: gather + dequant
     the layer's cached prefix pages, attend chunk-over-(prefix + chunk)
     with the absolute-position mask, finish the block.  Returns
     (x', k, v) with k/v the chunk's NEW KV [1, C, n_kv, d] — the caller
     owns the page write (plain path batches it across layers;
     the pipelined path scatters per stage with GPipe valid-masking).
-    ONE implementation for both, so the chunk attention/mask/dequant
-    contract cannot drift between them."""
+    ONE implementation for all paths, so the chunk attention/mask/
+    dequant contract cannot drift between them.
+
+    ``tp_axis``: manual-TP mode for use INSIDE a shard_map stage body
+    (the PP×TP prefix-hit path): the layer weights and ``k_pages``/
+    ``v_pages`` are this device's shards — the prefix gather reads the
+    local kv lanes (per-shard consistent with how the pipelined TP
+    prefill/decode wrote them, incl. the per-shard split-half int4
+    layout), attention runs on local head shards, and the row-parallel
+    wo / w_down partial sums psum-combine (mirroring
+    pipeline._block_prefill_tp)."""
     c_pad = x.shape[1]
     h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
     q, k, v = llama._qkv(cfg, layer, h, angles, positions)
-    # gather + dequant the cached prefix: [1, S_pre, n_kv, d]
+    # gather + dequant the cached prefix: [1, S_pre, n_kv(_local), d] —
+    # the kv-head count comes from the page buffer itself so the same
+    # code serves the global pool and a TP lane shard of it
+    kv_lanes = k_pages.shape[-1] * (2 if packed else 1)
+    n_kv = kv_lanes // cfg.head_dim
     kp = _gather_dequant_pages(
-        k_pages, k_scales, prefix_table[None], cfg.n_kv_heads,
+        k_pages, k_scales, prefix_table[None], n_kv,
         cfg.head_dim, dtype, packed)
     vp = _gather_dequant_pages(
-        v_pages, v_scales, prefix_table[None], cfg.n_kv_heads,
+        v_pages, v_scales, prefix_table[None], n_kv,
         cfg.head_dim, dtype, packed)
     attn = _chunk_attention(cfg, q,
                             jnp.concatenate([kp, k], axis=1),
                             jnp.concatenate([vp, v], axis=1), mask)
-    x = x + attn.reshape(1, c_pad, cfg.q_dim) @ dq(layer["wo"])
-    hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-    x = x + llama._mlp(cfg, layer, hm, ep_mesh)
+    out = attn.reshape(1, c_pad, -1) @ dq(layer["wo"])
+    if tp_axis is not None:
+        x = x + jax.lax.psum(out, tp_axis)
+        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        gate = jax.nn.silu(hm @ dq(layer["w_gate"]))
+        up = hm @ dq(layer["w_up"])
+        x = x + jax.lax.psum((gate * up) @ dq(layer["w_down"]), tp_axis)
+    else:
+        x = x + out
+        hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(cfg, layer, hm, ep_mesh)
     return x, k, v
 
 
@@ -808,13 +829,12 @@ class PagedInferenceEngine(EngineBase):
                                       params=params)
         self._pp = pp_mesh is not None
         if self._pp:
-            if engine_cfg.prefix_cache and (tp_mesh is not None
-                                            or ep_mesh is not None):
+            if engine_cfg.prefix_cache and ep_mesh is not None:
                 raise ValueError(
-                    "prefix_cache composes with stage-only PP; the "
-                    "chunked prefix prefill is per-sequence and not "
-                    "TP/EP-composed — use prefix_cache=False under "
-                    "PP×TP / PP×EP")
+                    "prefix_cache composes with stage-only PP and PP×TP "
+                    "(the pipelined chunked prefix prefill runs the "
+                    "manual-TP chunk layer); it is not EP-composed — "
+                    "use prefix_cache=False under PP×EP")
             if use_kernel:
                 raise ValueError(
                     "use_kernel=True is incompatible with pp_mesh (the "
@@ -1065,7 +1085,8 @@ class PagedInferenceEngine(EngineBase):
                 p, stk = params_t
                 return pp.paged_pp_prefill_chunk(
                     cfg, p, pool, toks, chunk_len, prefix_len,
-                    prefix_table, page_map, pp_mesh, pp_stage_axis, stk)
+                    prefix_table, page_map, pp_mesh, pp_stage_axis, stk,
+                    tp_axis=pp_tp_axis)
 
             self._prefill = None     # PP admits through the batched path
             # ... except prefix-cache HITS, which admit singly through the

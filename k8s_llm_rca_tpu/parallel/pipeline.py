@@ -923,7 +923,8 @@ def paged_pp_decode_multi(cfg, params, pool, tokens, lengths, block_tables,
 
 def paged_pp_prefill_chunk(cfg, params, pool, tokens, chunk_len,
                            prefix_len, prefix_table, page_map, mesh: Mesh,
-                           stage_axis: str = "stage", stacked_layers=None):
+                           stage_axis: str = "stage", stacked_layers=None,
+                           tp_axis: str = None):
     """Pipeline-parallel CHUNKED prefix prefill: the prefix-cache hit
     path under PP serving.  Prefills the non-cached SUFFIX of one prompt
     whose first ``prefix_len`` tokens' KV already sit in pool pages —
@@ -932,9 +933,15 @@ def paged_pp_prefill_chunk(cfg, params, pool, tokens, chunk_len,
     slice and scattering its chunk KV back (the pool's layer axis is
     stage-sharded).  One sequence, so the GPipe schedule degenerates to
     m=1 (sequential stages, no overlap) — the win here is the prefix KV
-    REUSE, not pipelining.  PP-only (no tp/ep composition: the chunk
-    path is per-sequence and the engines reject prefix_cache under the
-    composed meshes)."""
+    REUSE, not pipelining.
+
+    ``tp_axis``: the PP×TP composition — stage bodies run the manual-TP
+    chunk layer (``engine/paged._chunk_layer(tp_axis=)``: local head
+    shards, psum combines)
+    over the pool's kv-lane shard, so the agent-thread reuse the cache
+    was built for survives in the production stage×model mesh.  EP is
+    not composed (the chunk layer has no expert dispatch; the engines
+    reject prefix_cache under PP×EP)."""
     from k8s_llm_rca_tpu.engine.paged import _chunk_layer, _pool_packed
     from k8s_llm_rca_tpu.models import llama as L
 
@@ -976,19 +983,19 @@ def paged_pp_prefill_chunk(cfg, params, pool, tokens, chunk_len,
                 ks_li = vs_li = None
                 if quant:
                     ks_li, vs_li = xs[3], xs[4]
-                # shared per-layer chunk block (engine/paged._chunk_layer):
-                # gather cached prefix, attend, finish — identical to the
-                # plain path; only the page WRITE below is PP-specific
+                # shared per-layer chunk block (engine/paged._chunk_layer
+                # or its manual-TP twin): gather cached prefix, attend,
+                # finish — only the page WRITE below is PP-specific
                 x2, k, v = _chunk_layer(cfg, layer, carry, angles,
                                         positions, mask, k_li, v_li,
                                         ks_li, vs_li, prefix_tbl, dtype,
-                                        packed)
+                                        packed, tp_axis=tp_axis)
                 # scatter the chunk's KV into its new pages (valid-masked)
-                k_new = k[0].reshape(c_pad, cfg.kv_dim)
-                v_new = v[0].reshape(c_pad, cfg.kv_dim)
+                k_new = k[0].reshape(c_pad, -1)    # kv_dim or its TP shard
+                v_new = v[0].reshape(c_pad, -1)
                 if quant:
-                    k_new, ks = L._quantize_kv(k_new, packed)
-                    v_new, vs = L._quantize_kv(v_new, packed)
+                    k_new, ks = L._quantize_kv(k_new, packed, tp_axis)
+                    v_new, vs = L._quantize_kv(v_new, packed, tp_axis)
                     ks = ks.reshape(n_chunk_pages, page_size)
                     vs = vs.reshape(n_chunk_pages, page_size)
                     ks_li = ks_li.at[pages1].set(
@@ -1012,12 +1019,14 @@ def paged_pp_prefill_chunk(cfg, params, pool, tokens, chunk_len,
         return _gpipe_loop(stage_apply, x_mb, kv, 1, n_st, my, perm,
                            stage_axis)
 
+    stacked_spec = _stacked_in_specs(stacked, cfg, stage_axis, tp_axis,
+                                     None)
     out, kv_out = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(stage_axis), _kv_specs(quant, None, stage_axis),
+        in_specs=(stacked_spec, _kv_specs(quant, tp_axis, stage_axis),
                   P(*(None,) * 4), P(None, None), P(None, None), P(None),
                   P(None, None)),
-        out_specs=(P(*(None,) * 4), _kv_specs(quant, None, stage_axis)),
+        out_specs=(P(*(None,) * 4), _kv_specs(quant, tp_axis, stage_axis)),
         check_vma=False,
     )(stacked, _kv_tuple(pool), x_mb, mask, positions, prefix_table, pages)
 

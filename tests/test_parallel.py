@@ -1500,6 +1500,53 @@ def test_pp_paged_prefix_cache_reuse(cpu_devices, kv_dtype):
     eng.allocator.check()
 
 
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "int4"])
+def test_pp_tp_paged_prefix_cache_reuse(cpu_devices, kv_dtype):
+    """Prefix caching composes with PP×TP (VERDICT r4 item 9 — the
+    production mesh of the agent workload the cache was built for): a
+    repeated prompt's second admission routes through the pipelined
+    chunked prefix prefill whose stage bodies run the MANUAL-TP chunk
+    layer (paged._chunk_layer(tp_axis=): per-shard prefix gather incl. the per-shard
+    int4 layout, psum combines, pmax full-row scales) — greedy output
+    identical to the plain paged prefix engine, with real page-level KV
+    reuse."""
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine.paged import PagedInferenceEngine
+    from k8s_llm_rca_tpu.utils.logging import METRICS
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=64, n_layers=4)
+    mesh = build_mesh(MeshConfig(stage=2, model=2),
+                      devices=cpu_devices[:4])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    ecfg = EngineConfig(max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=64, prefill_buckets=(16, 32),
+                        max_new_tokens=6, temperature=0.0,
+                        prefix_cache=True, decode_chunk=1,
+                        kv_cache_dtype=kv_dtype)
+    prompt = tok.encode("incident pod crashloop in namespace prod",
+                        add_bos=True)
+    assert len(prompt) > 16            # spans >2 pages -> cacheable prefix
+
+    with jax.default_matmul_precision("float32"):
+        plain = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                     use_kernel=False)
+        p1 = plain.generate([list(prompt)], max_new_tokens=6)[0]
+        eng = PagedInferenceEngine(cfg, ecfg, params, tok,
+                                   use_kernel=False, pp_mesh=mesh,
+                                   tp_mesh=mesh)
+        r1 = eng.generate([list(prompt)], max_new_tokens=6)[0]
+        before = METRICS.count("engine.prefix_hit_tokens")
+        r2 = eng.generate([list(prompt)], max_new_tokens=6)[0]
+    assert r1.token_ids == p1.token_ids, kv_dtype
+    assert r2.token_ids == r1.token_ids, kv_dtype
+    # the second admission actually REUSED cached prefix KV through the
+    # pipelined manual-TP chunk path
+    assert METRICS.count("engine.prefix_hit_tokens") > before, kv_dtype
+    eng.allocator.check()
+
+
 def test_pp_engine_dfa_scan_parity(cpu_devices):
     """Grammar-constrained decode stays on the fast path under PP: the
     DFA rides inside the chunked scan whose body is the PIPELINED decode
@@ -1893,15 +1940,19 @@ def test_pp_mesh_validation(cpu_devices):
         make_engine(cfg, EngineConfig(**base), params, tok, pp_mesh=pp,
                     pp_microbatches=3)
     with pytest.raises(ValueError, match="prefix_cache"):
-        # prefix caching composes with stage-only PP (see
-        # test_pp_paged_prefix_cache_reuse) but not with the composed
-        # meshes — the chunked prefix prefill is per-sequence
-        pptp = build_mesh(MeshConfig(stage=2, model=2),
+        # prefix caching composes with stage-only PP and PP×TP (see
+        # test_pp_paged_prefix_cache_reuse / test_pp_tp_paged_prefix_
+        # cache_reuse) but not with PP×EP — the chunk layer has no
+        # expert dispatch
+        moe_cfg4 = TINY_MOE.replace(n_layers=4, n_experts=4,
+                                    max_seq_len=64)
+        ppep = build_mesh(MeshConfig(stage=2, expert=2),
                           devices=cpu_devices[:4])
         PagedInferenceEngine(
-            cfg, EngineConfig(paged=True, page_size=16, num_pages=32,
-                              prefix_cache=True, **base),
-            params, tok, pp_mesh=pptp, tp_mesh=pptp, use_kernel=False)
+            moe_cfg4, EngineConfig(paged=True, page_size=16, num_pages=32,
+                                   prefix_cache=True, **base),
+            llama.init_params(moe_cfg4, jax.random.PRNGKey(3)), tok,
+            pp_mesh=ppep, ep_mesh=ppep, use_kernel=False)
     with pytest.raises(ValueError, match="use_kernel"):
         PagedInferenceEngine(
             cfg, EngineConfig(paged=True, page_size=16, num_pages=32,
