@@ -44,6 +44,24 @@ from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 log = get_logger(__name__)
 
 
+def host_np(x) -> np.ndarray:
+    """Device->host fetch that also works on arrays spanning
+    NON-ADDRESSABLE devices (multi-process serving: the global mesh
+    covers other processes' devices, so plain ``np.asarray`` raises).
+    Fully-addressable values (incl. plain host arrays) fetch directly;
+    otherwise every process participates in a ``process_allgather`` —
+    safe because the engine's host driver runs SPMD-identically in all
+    processes (same prompts, same deterministic schedule), so the
+    collective lines up across the cluster.  ONE definition for both
+    engines and the speculative path: every per-tick sync routes
+    through here."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def flash_prefill_safe(params) -> bool:
     """Whether inference prefill may use the Pallas flash kernel: TPU
     backend and no multi-device (TP/EP) param sharding — pallas_call has
@@ -453,7 +471,7 @@ class EngineBase:
             self._key, sub = jax.random.split(self._key)
             masked = self._sample_masked(
                 logits, sub, self.sampling, jnp.asarray(c.allow[None]))
-            return int(masked[0])
+            return int(host_np(masked)[0])
         return sampled
 
     def _budget_remaining(self, st: _Active) -> int:
@@ -756,7 +774,7 @@ class EngineBase:
         k = self.engine_cfg.speculative_k
         if k <= 0 or self.engine_cfg.temperature != 0.0:
             return False
-        lengths_host = np.asarray(self.lengths)   # ONE device sync per tick
+        lengths_host = host_np(self.lengths)      # ONE device sync per tick
         return all(self._spec_room_ok(s, k + 1, lengths_host)
                    for s in self._active)
 
@@ -771,7 +789,7 @@ class EngineBase:
         if c.force is not None:
             return c.force
         if c.allow is not None:
-            masked = np.where(np.asarray(c.allow), np.asarray(logits_row),
+            masked = np.where(np.asarray(c.allow), host_np(logits_row),
                               -np.inf)
             return int(np.argmax(masked))
         return greedy_token
@@ -894,10 +912,10 @@ class EngineBase:
         host path (ship logits, _greedy_with_grammar per position).
         Returns (greedy_host [B, T], logits_host or None, constrained)."""
         if not self._need_spec_logits(active_slots):
-            return np.asarray(greedy), None, False
+            return host_np(greedy), None, False
         tables = self._uniform_dfa_tables()
         if tables is None:
-            return np.asarray(greedy), np.asarray(logits), False
+            return host_np(greedy), host_np(logits), False
         (allow_t, next_t, dist_t, close_t, complete_t,
          _) = self._dfa_device_tables(tables)
         states, remaining = self._dfa_scan_vectors(tables)
@@ -905,7 +923,7 @@ class EngineBase:
             logits, jnp.asarray(states), jnp.asarray(remaining),
             self.tokenizer.eos_id, allow_t, next_t, dist_t, close_t,
             complete_t)
-        return np.asarray(greedy), None, True
+        return host_np(greedy), None, True
 
 
 class InferenceEngine(EngineBase):
@@ -1248,14 +1266,14 @@ class InferenceEngine(EngineBase):
         self.lengths = self.lengths.at[jnp.asarray(active_slots)].add(1)
         if forced:
             # np.asarray of a device array is a read-only view; copy to edit
-            host_next = np.asarray(next_tokens).copy()
+            host_next = host_np(next_tokens).copy()
             for slot, token in forced.items():
                 host_next[slot] = token
             self.cur_tokens = jnp.asarray(host_next)
         else:
-            host_next = np.asarray(next_tokens)
+            host_next = host_np(next_tokens)
             self.cur_tokens = next_tokens
-        lengths_host = np.asarray(self.lengths)
+        lengths_host = host_np(self.lengths)
 
         for slot in active_slots:
             st = self._active[slot]
@@ -1290,7 +1308,7 @@ class InferenceEngine(EngineBase):
             self._key, sub = jax.random.split(self._key)
             first = self._sample(logits, sub, self.sampling)
         METRICS.inc("engine.prefill_tokens", n)
-        return self._activate(req, slot, logits, int(first[0]))
+        return self._activate(req, slot, logits, int(host_np(first)[0]))
 
     def _activate(self, req: _Pending, slot: int, logits_1v,
                   first_token: int) -> Optional[SequenceResult]:
@@ -1369,7 +1387,7 @@ class InferenceEngine(EngineBase):
         METRICS.inc("engine.batched_admissions", n)
 
         finished: List[SequenceResult] = []
-        firsts_host = np.asarray(firsts)
+        firsts_host = host_np(firsts)
         for i, req in enumerate(reqs):
             early = self._activate(req, slots[i], logits[i:i + 1],
                                    int(firsts_host[i]))
@@ -1417,7 +1435,7 @@ class InferenceEngine(EngineBase):
                     self.sampling, self.tokenizer.eos_id,
                     jnp.asarray(states), jnp.asarray(remaining),
                     allow_t, next_t, dist_t, close_t, complete_t)
-        toks_host = np.asarray(toks)                     # [chunk, B]
+        toks_host = host_np(toks)                        # [chunk, B]
         self.cur_tokens = toks[-1]
 
         return self._commit_scanned(active_slots, toks_host, chunk,
@@ -1432,7 +1450,7 @@ class InferenceEngine(EngineBase):
         greedy is computed ON DEVICE (dfa_greedy_multi) — spec×grammar
         keeps multi-token verify with no [B, T, V] logits transfer."""
         active_slots = list(self._active)
-        cur_host = np.asarray(self.cur_tokens)
+        cur_host = host_np(self.cur_tokens)
         tokens_in, drafts = self._build_drafts(active_slots, cur_host)
 
         with METRICS.timer("engine.decode_step"):
@@ -1442,7 +1460,7 @@ class InferenceEngine(EngineBase):
             greedy_host, logits_host, constrained = \
                 self._spec_constrained_greedy(greedy, logits, active_slots)
 
-        lengths_host = np.asarray(self.lengths).copy()
+        lengths_host = host_np(self.lengths).copy()
         next_cur = cur_host.copy()
 
         def post_commit(slot: int, token: int) -> None:

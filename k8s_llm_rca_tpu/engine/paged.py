@@ -37,7 +37,7 @@ import numpy as np
 from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
 from k8s_llm_rca_tpu.engine.engine import (
     EngineBase, SequenceResult, _Active, _Pending, flash_prefill_plan,
-    validate_cp_divisibility,
+    host_np, validate_cp_divisibility,
 )
 from k8s_llm_rca_tpu.engine.sampling import (
     SamplingParams, sample_tokens, sample_tokens_masked,
@@ -1293,7 +1293,7 @@ class PagedInferenceEngine(EngineBase):
                 next_tokens = self._sample(logits, sub, self.sampling)
         METRICS.inc("engine.decode_tokens", len(active_slots))
 
-        host_next = np.asarray(next_tokens)
+        host_next = host_np(next_tokens)
         for slot in active_slots:
             self.lengths[slot] += 1
             st = self._active[slot]
@@ -1383,7 +1383,7 @@ class PagedInferenceEngine(EngineBase):
                     jnp.asarray(states), jnp.asarray(remaining),
                     allow_t, next_t, dist_t, close_t, complete_t,
                     use_kernel=self.use_kernel)
-        toks_host = np.asarray(toks)                    # [chunk, B]
+        toks_host = host_np(toks)                       # [chunk, B]
 
         def post_commit(slot: int, token: int) -> None:
             self.lengths[slot] += 1
@@ -1538,7 +1538,7 @@ class PagedInferenceEngine(EngineBase):
         METRICS.inc("engine.prefill_tokens", len(rest))
 
         return self._activate_paged(req, slot, table, n_cp, logits,
-                                    int(first[0]))
+                                    int(host_np(first)[0]))
 
     def _activate_paged(self, req: _Pending, slot: int, table, n_cp: int,
                         logits_1v, first_token: int
@@ -1625,7 +1625,7 @@ class PagedInferenceEngine(EngineBase):
         METRICS.inc("engine.batched_admissions", n)
 
         finished: List[SequenceResult] = []
-        firsts_host = np.asarray(firsts)
+        firsts_host = host_np(firsts)
         for i, req in enumerate(reqs):
             early = self._activate_paged(req, slots[i], tables[i], 0,
                                          logits[i:i + 1],

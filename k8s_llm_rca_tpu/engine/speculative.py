@@ -162,7 +162,8 @@ class ModelDraft:
             jnp.asarray(self.cur, jnp.int32),
             jnp.asarray(self.lengths, jnp.int32),
             self._key, k + 1, self._greedy, eos_id)
-        toks_host = np.asarray(toks)                   # [k+1, B]
+        from k8s_llm_rca_tpu.engine.engine import host_np
+        toks_host = host_np(toks)                      # [k+1, B]
         out = {}
         for s in active_slots:
             if s in roomy:

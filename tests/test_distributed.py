@@ -46,17 +46,22 @@ def _spawn(pid: int, n_proc: int, port: int) -> subprocess.Popen:
         env=env)
 
 
-def test_two_process_cluster_psum_and_train_step():
+def test_two_process_cluster_psum_train_and_serve():
     """Coordinator (process 0) + worker (process 1) form a cluster via
     initialize_distributed; each asserts the global device view, runs a
-    cross-process psum and a DP×TP train step whose gradient reductions
-    cross the process boundary.  Both must exit 0 with matching losses."""
+    cross-process psum, a DP×TP train step whose gradient reductions
+    cross the process boundary, and then SERVES: both engines (contiguous
+    + paged) prefill and decode over the process-spanning TP mesh, every
+    tick's collectives crossing the process boundary.  Both processes
+    must exit 0 with matching losses, matching served tokens, and the
+    served tokens must equal a SINGLE-process unsharded engine's greedy
+    output (computed here) — the DCN serving claim, executed."""
     port = _free_port()
     procs = [_spawn(i, 2, port) for i in range(2)]
     outs = []
     for i, p in enumerate(procs):
         try:
-            out, _ = p.communicate(timeout=360)
+            out, _ = p.communicate(timeout=600)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -71,3 +76,29 @@ def test_two_process_cluster_psum_and_train_step():
               for out in outs for line in out.splitlines()
               if "loss=" in line]
     assert len(losses) == 2 and losses[0] == losses[1], losses
+
+    # serving parity: both processes emitted identical tokens per leg
+    def serve_lines(out):
+        return {line.split("serve[")[1].split("]=")[0]:
+                line.split("]=")[1].strip()
+                for line in out.splitlines() if "serve[" in line}
+
+    served = [serve_lines(o) for o in outs]
+    assert set(served[0]) == {"contig/batch", "contig/single",
+                              "paged/batch", "paged/single"}, served[0]
+    assert served[0] == served[1], (served[0], served[1])
+
+    # ... and match the single-process unsharded engines exactly — the
+    # scenario definition is SHARED with the worker
+    # (tests/_distributed_serve_config.py), so both sides serve the same
+    # prompts/configs by construction
+    from k8s_llm_rca_tpu.engine import make_engine
+
+    import _distributed_serve_config as serve_cfg
+
+    def _make_plain(cfg, params, tok, ecfg, paged):
+        kw = dict(use_kernel=False) if paged else {}
+        return make_engine(cfg, ecfg, params, tok, **kw)
+
+    want = serve_cfg.serve_all(_make_plain)
+    assert served[0] == want, (served[0], want)
