@@ -239,7 +239,14 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
     if pp_mesh is None:
         return None
     if cp_mesh is not None:
-        raise ValueError("pp_mesh and cp_mesh are mutually exclusive")
+        raise ValueError(
+            "pp_mesh and cp_mesh are mutually exclusive by design: "
+            "stage-local CP replicates the matmul FLOPs and weight "
+            "streaming that stage-local TP divides (1.2-3.6x per-device "
+            "cost at 4k-128k contexts — "
+            "runtime.profiling.stage_local_cp_vs_tp and "
+            "docs/parallelism.md 'PP×CP: a quantified no'); use PP×TP, "
+            "or CP×TP for GQA-limited long contexts")
     if ep_mesh is not None:
         if ep_mesh is not pp_mesh:
             raise ValueError(

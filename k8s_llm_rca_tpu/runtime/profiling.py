@@ -183,6 +183,59 @@ def decode_bytes_per_token(cfg: ModelConfig, context_len: int, batch: int,
     return 1.01 * (weight_per_token + kv_per_token)
 
 
+def stage_local_cp_vs_tp(cfg: ModelConfig, context_len: int, batch: int,
+                         n_intra: int, weight_bits: int = 16,
+                         kv_bits: int = 16) -> Dict[str, float]:
+    """Per-device decode cost of spending a pipeline stage's INTRA-stage
+    devices on TP vs on CP — the quantitative basis for excluding PP×CP
+    (docs/parallelism.md "PP×CP: a quantified no").
+
+    The asymmetry: TP divides the matmul FLOPs and weight bytes by
+    ``n_intra`` AND the attention/KV terms by their head-granularity
+    limits (q-head compute by min(n, n_heads); KV-cache bytes by
+    min(n, n_kv_heads) — beyond the GQA limit the KV stream replicates
+    across the devices sharing a kv head), while stage-local CP divides
+    ONLY the attention/KV terms — every seq shard still runs the full
+    matmuls for the decoded token and streams the full weights.  Below
+    the GQA limit TP is therefore strictly cheaper on both axes at
+    every context length; past it (n_intra > n_kv_heads, S ≳ 100k) CP
+    genuinely wins on KV bytes — the regime served by the existing
+    non-PP CP×TP composition, which this model also demonstrates
+    (tests/test_profiling.py::TestStageLocalCpVsTp).
+
+    The matmul/weight terms derive from the SAME canonical cost
+    functions the bench rooflines use (``decode_flops_per_token`` /
+    ``decode_bytes_per_token``), so the exclusion numbers cannot drift
+    from the roofline model.  They include the logits matmul, which on
+    a real pipeline lives only in the LAST stage — non-final stages
+    have a slightly smaller matmul share and thus a cp/tp ratio
+    slightly closer to (but still above) 1, so the whole-stack ratios
+    reported here are an upper bound on each stage's.
+
+    Returns per-device per-token {flops,bytes}_{tp,cp} and the cp/tp
+    ratios (>1 = CP loses).
+    """
+    f_attn = cfg.n_layers * 2.0 * 2 * cfg.n_heads * cfg.head_dim \
+        * context_len
+    f_matmul = decode_flops_per_token(cfg, context_len) - f_attn
+    kv_per_token = (cfg.n_layers * 2 * cfg.kv_dim
+                    * (context_len + 1) * kv_bits / 8.0)
+    w_per_token = decode_bytes_per_token(
+        cfg, context_len, batch, weight_bits, kv_bits) / 1.01 \
+        - kv_per_token
+    n_q = min(n_intra, cfg.n_heads)
+    n_kv = min(n_intra, cfg.n_kv_heads)
+    out = {
+        "flops_tp": f_matmul / n_intra + f_attn / n_q,
+        "flops_cp": f_matmul + f_attn / n_intra,
+        "bytes_tp": w_per_token / n_intra + kv_per_token / n_kv,
+        "bytes_cp": w_per_token + kv_per_token / n_intra,
+    }
+    out["flops_cp_over_tp"] = out["flops_cp"] / out["flops_tp"]
+    out["bytes_cp_over_tp"] = out["bytes_cp"] / out["bytes_tp"]
+    return out
+
+
 def roofline_decode_tps(cfg: ModelConfig, context_len: int, batch: int,
                         weight_bits: int = 16, kv_bits: int = 16,
                         device: Optional[Any] = None) -> Optional[float]:

@@ -121,3 +121,56 @@ class TestTraceAndMemory:
         # plugins/profile/<ts>/*.xplane.pb must exist
         found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
         assert any(f.endswith(".xplane.pb") for f in found), found
+
+
+class TestStageLocalCpVsTp:
+    def test_tp_dominates_cp_below_the_gqa_limit(self):
+        """The PP×CP exclusion's quantitative basis (docs/parallelism.md
+        "a quantified no"): for n_intra <= n_kv_heads, spending a
+        pipeline stage's intra-stage devices on TP beats CP on BOTH
+        per-device decode FLOPs and HBM bytes at every context length —
+        CP divides only the attention/KV terms while TP divides the
+        matmul/weight terms too."""
+        from k8s_llm_rca_tpu.config import LLAMA3_8B, TINYLLAMA_1B
+
+        for cfg in (LLAMA3_8B, TINYLLAMA_1B):
+            for n_intra in (2, 4, 8):
+                if n_intra > cfg.n_kv_heads:
+                    continue
+                for s in (1024, 4096, 32768, 131072):
+                    r = profiling.stage_local_cp_vs_tp(
+                        cfg, s, batch=16, n_intra=n_intra,
+                        weight_bits=4, kv_bits=4)
+                    assert r["flops_cp_over_tp"] > 1.0, (cfg.name, s)
+                    assert r["bytes_cp_over_tp"] > 1.0, (cfg.name, s)
+
+    def test_cp_wins_kv_bytes_past_the_gqa_limit(self):
+        """The model is honest about CP's genuine regime: past the GQA
+        limit (n_intra > n_kv_heads) at long context, TP's KV stream
+        replicates across the devices sharing a kv head while CP keeps
+        dividing it — so CP wins on HBM bytes there (the case served by
+        the non-PP CP×TP composition, docs/parallelism.md)."""
+        from k8s_llm_rca_tpu.config import TINYLLAMA_1B
+
+        assert TINYLLAMA_1B.n_kv_heads == 4
+        r = profiling.stage_local_cp_vs_tp(TINYLLAMA_1B, 131072, batch=16,
+                                           n_intra=8)
+        assert r["bytes_cp_over_tp"] < 1.0, r
+        # ... while matmul-replication still costs CP the FLOP axis
+        assert r["flops_cp_over_tp"] > 1.0, r
+
+    def test_ratio_shrinks_with_context_but_never_crosses(self):
+        """CP's relative loss shrinks as attention dominates (its only
+        asymptotic argument) yet stays >1 even at 1M tokens — the
+        crossover never happens because weights are still streamed per
+        seq shard."""
+        from k8s_llm_rca_tpu.config import LLAMA3_8B
+
+        prev = None
+        for s in (4096, 65536, 1048576):
+            r = profiling.stage_local_cp_vs_tp(LLAMA3_8B, s, batch=16,
+                                               n_intra=4)
+            if prev is not None:
+                assert r["flops_cp_over_tp"] < prev
+            assert r["flops_cp_over_tp"] > 1.0
+            prev = r["flops_cp_over_tp"]
