@@ -242,8 +242,8 @@ def validate_pp_mesh(pp_mesh, model_cfg, engine_cfg, cp_mesh, ep_mesh,
         raise ValueError(
             "pp_mesh and cp_mesh are mutually exclusive by design: "
             "stage-local CP replicates the matmul FLOPs and weight "
-            "streaming that stage-local TP divides (1.2-3.6x per-device "
-            "cost at 4k-128k contexts — "
+            "streaming that stage-local TP divides (1.5-3.6x the FLOPs, "
+            "1.2-2.9x the HBM bytes per device at 4k-128k contexts — "
             "runtime.profiling.stage_local_cp_vs_tp and "
             "docs/parallelism.md 'PP×CP: a quantified no'); use PP×TP, "
             "or CP×TP for GQA-limited long contexts")

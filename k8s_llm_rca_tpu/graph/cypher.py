@@ -716,13 +716,22 @@ def _match_pattern(graph: Graph, pattern: Pattern, row: Dict[str, Any],
         return new
 
     def rel_steps(node: Node, rel_pat: RelPat):
-        """(relationship, neighbor) pairs leaving ``node`` under rel_pat."""
+        """(relationship, neighbor) pairs leaving ``node`` under rel_pat.
+
+        An undirected pattern traverses a SELF-LOOP once, not once per
+        orientation (Neo4j/openCypher loop semantics; found by the
+        brute-force differential oracle, tests/test_cypher_differential
+        .py — the out pass already yielded the loop, so the in pass must
+        skip it or every loop row would double)."""
         steps = []
         if rel_pat.direction in ("out", "both"):
             for r in graph.out_rels(node):
                 steps.append((r, r.end_node))
         if rel_pat.direction in ("in", "both"):
             for r in graph.in_rels(node):
+                if rel_pat.direction == "both" \
+                        and r.start_node is r.end_node:
+                    continue
                 steps.append((r, r.start_node))
         if rel_pat.type is not None:
             steps = [(r, n) for (r, n) in steps if r.type == rel_pat.type]
