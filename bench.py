@@ -60,7 +60,8 @@ def _metrics_ticks() -> float:
 def bench_engine_model(model_key: str, max_batch: int, max_seq_len: int,
                        page_size: int, num_pages: int, n_prompts: int,
                        prompt_len: int, max_new: int,
-                       decode_chunk: int = 32, use_kernel=None):
+                       decode_chunk: int = 32, use_kernel=None,
+                       kv_dtype: str = "int4"):
     """Measured tokens/sec of a REAL model through the paged
     continuous-batching engine (int4 weights + int4 KV, the flagship
     quant config; the Pallas paged-attention kernel on the decode path).
@@ -95,7 +96,7 @@ def bench_engine_model(model_key: str, max_batch: int, max_seq_len: int,
                         prefill_buckets=(prompt_len,),
                         max_new_tokens=max_new, temperature=0.0,
                         decode_chunk=decode_chunk, prefix_cache=False,
-                        kv_cache_dtype="int4")
+                        kv_cache_dtype=kv_dtype)
     engine = make_engine(cfg, ecfg, params, tok, use_kernel=use_kernel)
 
     rng = np.random.default_rng(7)
@@ -119,8 +120,9 @@ def bench_engine_model(model_key: str, max_batch: int, max_seq_len: int,
 
     ctx = prompt_len + max_new // 2
     u = profiling.mfu(cfg, tps, ctx) if tps else None
+    kv_bits = {"int4": 4, "int8": 8, None: 16}[kv_dtype]
     roof = profiling.roofline_decode_tps(cfg, ctx, max_batch,
-                                         weight_bits=4, kv_bits=4)
+                                         weight_bits=4, kv_bits=kv_bits)
     occ = (tokens / (ticks * max_batch * decode_chunk)
            if ticks else None)
     return {"tps": round(tps, 2) if tps else None,
