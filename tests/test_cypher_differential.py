@@ -295,3 +295,83 @@ def test_shortest_pruning_inputs_match_brute_force():
             return d
 
         assert dist(got) == dist(want), seed
+
+
+# ---------------------------------------------------------------------------
+# the ladder's FULL rung-1/rung-2 query text, WHERE clauses included
+# ---------------------------------------------------------------------------
+
+# the PRODUCTION rung queries, imported — not retyped — so an edit to
+# the locator's WHERE clauses is differentially validated automatically
+from k8s_llm_rca_tpu.rca.locator import _Q_DIRECTED, _Q_UNDIRECTED
+
+LADDER = {"->": _Q_DIRECTED.format(hops=3),
+          "-": _Q_UNDIRECTED.format(hops=3)}
+
+
+def brute_ladder(graph, direction, src_kind, dest_kind, inter_kinds):
+    """Spec oracle for the FULL rung query: raw var-length trails plus an
+    independent re-implementation of every WHERE clause — node
+    uniqueness (the all/single quantifier pair), the Event/Namespace
+    kind exclusion, endpoint kinds, and the optional intermediate-kind
+    disjunction.  Written against the openCypher semantics, not against
+    the interpreter's quantifier machinery."""
+    out = []
+    by_id = {n.element_id: n for n in graph.nodes}
+    for node_ids, rel_ids in brute_paths(graph, [],
+                                         [(direction, None, 1, 3)], []):
+        path_nodes = [by_id[i] for i in node_ids]
+        if path_nodes[0]["kind"] != src_kind:
+            continue
+        if path_nodes[-1]["kind"] != dest_kind:
+            continue
+        if len(set(node_ids)) != len(node_ids):     # node uniqueness
+            continue
+        if any(n["kind"] in ("Event", "Namespace") for n in path_nodes):
+            continue
+        if inter_kinds:
+            if not any(n["kind"] in inter_kinds
+                       for n in path_nodes[1:-1]):
+                continue
+        out.append((node_ids, rel_ids))
+    return out
+
+
+LADDER_KINDS = ["Pod", "Node", "Svc", "Pvc", "Event", "Namespace"]
+
+
+def ladder_graph(rng):
+    g = Graph()
+    nodes = []
+    for i in range(rng.randint(4, 8)):
+        kind = rng.choice(LADDER_KINDS)
+        nodes.append(g.add_node([kind], kind=kind, idx=i))
+    for _ in range(rng.randint(3, 14)):
+        a, b = rng.choice(nodes), rng.choice(nodes)
+        g.add_relationship(a, rng.choice(TYPES), b)
+    return g
+
+
+@pytest.mark.parametrize("arrow", ["->", "-"])
+def test_full_ladder_query_matches_brute_force(arrow):
+    """Rungs 1 (directed) and 2 (undirected) of the metapath ladder —
+    the exact query TEXT the locator runs, quantifier WHERE clauses and
+    all — against the spec oracle on random graphs that include Event /
+    Namespace decoys and cycles, across empty / null / non-empty
+    $intermediateKinds."""
+    direction = ">" if arrow == "->" else "-"
+    for seed in range(40):
+        rng = random.Random(11000 + seed)
+        g = ladder_graph(rng)
+        src, dest = rng.choice(LADDER_KINDS[:4]), rng.choice(LADDER_KINDS[:4])
+        inter = rng.choice([None, [], ["Node"], ["Node", "Svc"]])
+        rows = run_query(g, LADDER[arrow],
+                         {"srcKind": src, "destKind": dest,
+                          "intermediateKinds": inter})
+        got = Counter(
+            (tuple(n.element_id for n in row["path"].nodes),
+             tuple(r.element_id for r in row["path"].relationships))
+            for row in rows)
+        want = Counter(brute_ladder(g, direction, src, dest, inter or []))
+        assert got == want, (arrow, seed, sorted(got - want),
+                             sorted(want - got))
