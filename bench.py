@@ -318,6 +318,26 @@ def bench_rca_p50_engine(n_incidents: int = 100, workers: int = 16,
             max_batch]
 
 
+def bench_rca_chaos(seed: int = 0, n_incidents: int = 6):
+    """Seeded chaos soak over the RCA sweep (faults/soak.py): graph
+    faults + backend faults + engine tick faults against the resilient
+    pipeline (retry/breaker/degradation ladder).  Publishes COUNTS, not
+    times — completed/degraded incidents and retries are exact
+    measurements of the run, so the publication policy's
+    measurement-or-null rule applies trivially.  Runs on the TINY paged
+    engine (CPU-safe): chaos behavior, not throughput, is the metric."""
+    from k8s_llm_rca_tpu.faults.soak import run_chaos_soak
+
+    report = run_chaos_soak(seed=seed, n_incidents=n_incidents,
+                            backend="engine")
+    return {"completed": report["completed"],
+            "degraded": report["degraded"],
+            "failed": report["failed"],
+            "retries": report["retries"],
+            "faults_fired": len(report["faults"]["fired"]),
+            "seed": seed, "n": n_incidents}
+
+
 def bench_rca_p50_engine_refthreads(n_incidents: int = 100):
     """The REFERENCE-FAITHFUL thread semantics, measured (VERDICT r4
     weak #4): threads grow across each worker's incidents exactly as the
@@ -407,6 +427,7 @@ def main():
     ref_sweep = _leg("bench.bench_rca_p50_engine_refthreads()",
                      timeout=1800)
     p50_refthreads = ref_sweep[0] if ref_sweep else None
+    chaos = _leg("bench.bench_rca_chaos()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -481,6 +502,13 @@ def main():
         if p50_refthreads is not None else None,
         "rca_engine_incidents": n_engine,
         "rca_engine_workers": n_workers,
+        # seeded chaos soak (faults/): exact run counts or null if the
+        # leg failed — the schema stays stable round over round
+        "rca_chaos_completed_incidents": chaos.get("completed"),
+        "rca_chaos_degraded_incidents": chaos.get("degraded"),
+        "rca_chaos_failed_incidents": chaos.get("failed"),
+        "rca_chaos_retries": chaos.get("retries"),
+        "rca_chaos_faults_fired": chaos.get("faults_fired"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

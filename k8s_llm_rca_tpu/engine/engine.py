@@ -37,6 +37,7 @@ from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
 from k8s_llm_rca_tpu.engine.sampling import (
     SamplingParams, sample_tokens, sample_tokens_masked,
 )
+from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
@@ -461,6 +462,56 @@ class EngineBase:
 
     def _register(self, seq_id: int, prompt_ids: List[int]) -> None:
         """Subclass hook called once per submitted sequence."""
+
+    def cancel_seq(self, seq_id: int) -> bool:
+        """Abort a sequence NOW: a queued request leaves the pending list,
+        an active one retires its slot immediately (the paged engine frees
+        its pages through the normal ``_retire`` path, so an abandoned run
+        cannot leak allocator blocks).  No result is produced — callers
+        that already dropped the handle simply never see one.  Returns
+        whether the sequence was still live."""
+        for i, req in enumerate(self._pending):
+            if req.seq_id == seq_id:
+                del self._pending[i]
+                self._prompts.pop(seq_id, None)
+                resumed = getattr(self, "_resumed", None)
+                if resumed is not None:
+                    resumed.pop(seq_id, None)
+                return True
+        for slot, st in list(self._active.items()):
+            if st.seq_id == seq_id:
+                self._retire(slot, "cancelled")
+                return True
+        return False
+
+    # -------------------------------------------------- fault injection
+
+    FAULT_SITE = inject.SITE_ENGINE_TICK
+
+    def _tick_fault(self) -> None:
+        """Apply this tick's scheduled fault (faults/plan.py).  Only ever
+        called behind ``inject._ARMED is not None`` at the top of
+        ``step()`` — the disarmed hot path pays exactly that one check."""
+        plan = inject._ARMED
+        if plan is None:
+            return
+        fault = plan.poll(self.FAULT_SITE)
+        if fault is not None:
+            self._apply_tick_fault(fault, plan)
+
+    def _apply_tick_fault(self, fault, plan) -> None:
+        """Base engine tick faults: host stall (virtual-clock delay).  The
+        paged engine overrides to add allocator exhaustion and forced
+        preemption waves; page-pool kinds scheduled against the contiguous
+        engine are ignored with a warning (no pool to exhaust)."""
+        if fault.kind in ("stall", "slow"):
+            plan.clock.sleep(fault.delay_s or 0.05)
+        elif fault.kind in ("oom", "preempt"):
+            log.warning("tick fault %r ignored: contiguous engine has no "
+                        "page pool", fault.kind)
+        else:
+            log.warning("tick fault %r not applicable to engine ticks",
+                        fault.kind)
 
     # ------------------------------------------------ grammar application
 
@@ -1230,6 +1281,8 @@ class InferenceEngine(EngineBase):
     def step(self) -> List[SequenceResult]:
         """One engine tick: admit pending into free slots, then one decode
         step for all active slots.  Returns sequences finished this tick."""
+        if inject._ARMED is not None:          # disarmed cost: this check
+            self._tick_fault()
         finished: List[SequenceResult] = []
         while self._pending and self._free_slots:
             group = self._admission_group()

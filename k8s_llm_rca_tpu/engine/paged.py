@@ -42,6 +42,7 @@ from k8s_llm_rca_tpu.engine.engine import (
 from k8s_llm_rca_tpu.engine.sampling import (
     SamplingParams, sample_tokens, sample_tokens_masked,
 )
+from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.models import llama
 from k8s_llm_rca_tpu.models.quant import dq, gather_rows
 from k8s_llm_rca_tpu.models.llama import _quantize_kv
@@ -59,6 +60,10 @@ from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 log = get_logger(__name__)
 
 TRASH_PAGE = 0
+
+# allocator owner tag for pages stolen by an injected "oom" tick fault
+# (sequence ids are >= 0; the prefix cache owns -2)
+FAULT_OWNER = -3
 
 
 class AllocatorError(RuntimeError):
@@ -363,6 +368,16 @@ def _chunk_attention(cfg: ModelConfig, q, k_all, v_all, mask):
     from k8s_llm_rca_tpu.ops.attention import NEG_INF, repeat_kv
 
     n_rep = cfg.n_heads // cfg.n_kv_heads
+    # enforce the GQA invariant where it is CONSUMED: the repeat factor is
+    # the global cfg ratio while the kv-head count comes from the (possibly
+    # sharded) page buffer — consistent only when whole GQA groups live per
+    # shard.  A mesh sharding q-heads but not kv-heads must fail loudly
+    # here, not attend with the wrong repeat factor.
+    assert q.shape[2] == n_rep * k_all.shape[2], (
+        f"GQA repeat mismatch in _chunk_attention: q heads {q.shape[2]} != "
+        f"n_rep {n_rep} (= n_heads//n_kv_heads) * local kv heads "
+        f"{k_all.shape[2]} — the mesh shards q-heads and kv-heads "
+        f"differently; shard whole GQA groups per device")
     k = repeat_kv(k_all, n_rep).astype(jnp.float32)
     v = repeat_kv(v_all, n_rep).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.float32(cfg.head_dim))
@@ -1071,6 +1086,8 @@ class PagedInferenceEngine(EngineBase):
         self._prompts: Dict[int, List[int]] = {}   # seq_id -> ORIGINAL prompt
         self._resumed: Dict[int, List[int]] = {}   # seq_id -> pre-preemption
                                                    #           generated tokens
+        self._fault_pages: List[int] = []   # pages stolen by an injected
+                                            # "oom" tick fault (one tick)
 
         # donate the KV pool so XLA updates it in place — without donation
         # every tick copies the whole pool and peak HBM doubles.  (CPU has
@@ -1224,7 +1241,45 @@ class PagedInferenceEngine(EngineBase):
         prefix = self._resumed.get(st.seq_id)
         return prefix + st.generated if prefix else st.generated
 
+    # -------------------------------------------------- fault injection
+
+    def _tick_fault(self) -> None:
+        # pages stolen by a previous tick's "oom" fault return first, so
+        # exhaustion lasts exactly one tick (and the plan's disarm cleanup
+        # covers a run that ends mid-fault)
+        self._release_fault_pages()
+        super()._tick_fault()
+
+    def _release_fault_pages(self) -> None:
+        if self._fault_pages:
+            self.allocator.free(self._fault_pages, owner=FAULT_OWNER)
+            self._fault_pages = []
+
+    def _apply_tick_fault(self, fault, plan) -> None:
+        """Paged tick faults: forced preemption wave ("preempt": evict the
+        ``wave`` youngest sequences, exercising requeue/resume), allocator
+        exhaustion ("oom": steal the whole free list for one tick, so this
+        tick's growth pass runs the real pool-pressure machinery), plus
+        the base host-stall kinds."""
+        if fault.kind == "preempt":
+            for _ in range(max(1, fault.wave)):
+                if not self._preempt_youngest():
+                    break
+        elif fault.kind == "oom":
+            if self._cp_parts:
+                log.warning("oom tick fault skipped: partitioned CP pool")
+                return
+            n = self.allocator.n_free
+            if n:
+                self._fault_pages = self.allocator.alloc(n,
+                                                         owner=FAULT_OWNER)
+                plan.add_cleanup(self._release_fault_pages)
+        else:
+            super()._apply_tick_fault(fault, plan)
+
     def step(self) -> List[SequenceResult]:
+        if inject._ARMED is not None:          # disarmed cost: this check
+            self._tick_fault()
         finished: List[SequenceResult] = []
         while self._pending and self._free_slots:
             group, matches = self._admission_group()
@@ -1526,8 +1581,13 @@ class PagedInferenceEngine(EngineBase):
             # group makes progress
             n_pages_hit = max(1, self._bucket(
                 max(1, b0 - matched[1])) // self.page_size)
+            # the cap mirrors what _alloc_with_evict can actually satisfy:
+            # free pages PLUS refcount-0 prefix-cache pages (evictable on
+            # pressure) — counting n_free alone split hit waves into more
+            # dispatches than the pool could really serve
+            supply = self.allocator.n_free + self.prefix_cache.n_evictable
             cap = min(16, len(self._free_slots),
-                      max(1, self.allocator.n_free // n_pages_hit))
+                      max(1, supply // n_pages_hit))
             for req in itertools.islice(self._pending, 1, None):
                 if (len(group) >= cap
                         or self._bucket(len(req.prompt_ids)) != b0):
@@ -1546,8 +1606,13 @@ class PagedInferenceEngine(EngineBase):
         # sized past the pool would fail forever where admitting the head
         # alone (which can also evict prefix pages) makes progress
         n_pages = max(1, b0 // self.page_size)
+        # same supply arithmetic as the hit cap: _admit_batch allocates via
+        # _alloc_with_evict, which can also reclaim refcount-0 prefix pages
+        supply = self.allocator.n_free + (
+            self.prefix_cache.n_evictable
+            if self.prefix_cache is not None else 0)
         cap = min(8, len(self._free_slots),
-                  max(1, self.allocator.n_free // n_pages))
+                  max(1, supply // n_pages))
         for req in itertools.islice(self._pending, 1, None):
             if (len(group) >= cap
                     or self._bucket(len(req.prompt_ids)) != b0):

@@ -1,0 +1,20 @@
+"""Deterministic fault injection + resilience for the serving/RCA stack.
+
+- ``faults.plan``   — seeded `FaultPlan` schedules + `VirtualClock`;
+- ``faults.inject`` — arming and the call-site injection points
+  (graph executors, EngineBackend, engine tick loops);
+- ``faults.policy`` — RetryPolicy / CircuitBreaker / degradation ladder;
+- ``faults.soak``   — the chaos soak driver (imported lazily: it pulls in
+  the whole rca pipeline, which itself imports the injection points).
+"""
+
+from k8s_llm_rca_tpu.faults.plan import (  # noqa: F401
+    FAULT_KINDS, Fault, FaultPlan, VirtualClock,
+)
+from k8s_llm_rca_tpu.faults.inject import (  # noqa: F401
+    InjectedFault, InjectedTimeout, arm, armed, disarm,
+)
+from k8s_llm_rca_tpu.faults.policy import (  # noqa: F401
+    CircuitBreaker, CircuitOpen, ResiliencePolicy, ResilientExecutor,
+    RetriesExhausted, RetryPolicy, StageDegradation,
+)

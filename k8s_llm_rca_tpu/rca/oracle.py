@@ -42,6 +42,32 @@ _DEST_RULES: List[Tuple[str, str, List[str]]] = [
 ]
 
 
+def scripted_plan(error_message: str, src_kind: str,
+                  native_kinds: List[str],
+                  external_kinds: List[str]) -> Dict[str, object]:
+    """Deterministic destKind plan from the keyword rules — the oracle's
+    planning brain without the prompt plumbing.  Doubles as the
+    degradation ladder's scripted-oracle rung (faults/policy.py): when
+    every engine-backed planning rung fails, the pipeline falls back to
+    this, annotated as degraded."""
+    allowed = set(native_kinds) | set(external_kinds)
+    msg = error_message.lower()
+    dest, inter = "Node", []
+    for pattern, cand, cand_inter in _DEST_RULES:
+        if re.search(pattern, msg) and cand in allowed:
+            dest, inter = cand, [k for k in cand_inter if k in allowed]
+            break
+    resources = [src_kind] + inter + [dest]
+    hops = [{"Edge": i + 1, "start": resources[i], "end": resources[i + 1]}
+            for i in range(len(resources) - 1)]
+    return {
+        "SourceKind": src_kind,
+        "DestinationKind": dest,
+        "RelevantResources": resources,
+        "PrimaryPath": hops,
+    }
+
+
 class OracleBackend:
     def __init__(self, tokenizer: Tokenizer,
                  chaos: Optional[Dict[str, int]] = None):
@@ -145,25 +171,11 @@ class OracleBackend:
             return '{"DestinationKind": broken'   # malformed on purpose
         native = _list_after(prompt, "k8s-api-resource-kinds:")
         external = _list_after(prompt, "k8s-external-resource-kinds:")
-        allowed = set(native + external)
         m = re.search(r"mentions a (\w+)", prompt)
         src = m.group(1) if m else "Pod"
         tail = prompt[prompt.rfind("strictly within the provided lists:"):]
-        msg = tail.lower()
-        dest, inter = "Node", []
-        for pattern, cand, cand_inter in _DEST_RULES:
-            if re.search(pattern, msg) and cand in allowed:
-                dest, inter = cand, [k for k in cand_inter if k in allowed]
-                break
-        resources = [src] + inter + [dest]
-        hops = [{"Edge": i + 1, "start": resources[i], "end": resources[i + 1]}
-                for i in range(len(resources) - 1)]
-        return json.dumps({
-            "SourceKind": src,
-            "DestinationKind": dest,
-            "RelevantResources": resources,
-            "PrimaryPath": hops,
-        }, indent=2)
+        return json.dumps(scripted_plan(tail, src, native, external),
+                          indent=2)
 
     def _compile_cypher(self, prompt: str) -> str:
         if self._chaotic("cypher"):

@@ -8,6 +8,13 @@ deterministic compiler fallback on exhaustion OR zero records -> per-record
 statepath audit -> per-incident result dict with time_cost and windowed
 token usage (the exact batch-driver output schema,
 test_with_file.py:67-204).
+
+With a ``resilience`` policy attached (faults/policy.ResiliencePolicy) every
+stage additionally walks a graceful-degradation ladder — full engine run ->
+one reduced-token-budget attempt -> scripted-oracle fallback -> annotated
+partial result — and the incident dict carries a ``degraded`` list naming
+every rung drop.  Without one, behavior is exactly the reference-faithful
+fail-fast control flow above.
 """
 
 from __future__ import annotations
@@ -41,6 +48,12 @@ class RCAPipeline:
     # when set, statepath audits run in relevance order and can be capped
     # with cfg.rerank_top_k
     reranker: Optional[Any] = None
+    # optional faults.policy.ResiliencePolicy: every stage then runs a
+    # graceful-degradation ladder (full engine run -> reduced token budget
+    # -> scripted-oracle fallback -> annotated partial result) and the
+    # incident dict carries a "degraded" annotation list.  None (the
+    # default) keeps the reference-faithful fail-fast behavior unchanged.
+    resilience: Optional[Any] = None
 
     def __post_init__(self):
         # vocabulary first: the locator's structured-output schema constrains
@@ -105,6 +118,31 @@ class RCAPipeline:
             f"destKind planning failed after "
             f"{self.cfg.locator_max_attempts} attempts") from last_err
 
+    def _plan_reduced(self, error_message: str,
+                      src_kind: str) -> (Dict[str, Any], int):
+        """Degradation rung 2: ONE planning attempt at a reduced token
+        budget (resilience.reduced_tokens).  The same schema grammar still
+        applies, so a budget below its minimal document raises BudgetError
+        immediately and the ladder falls through to the scripted rung."""
+        import dataclasses as _dc
+
+        from k8s_llm_rca_tpu.utils.fenced import extract_json
+
+        gen = _dc.replace(self.locator.assistant.gen,
+                          max_new_tokens=self.resilience.reduced_tokens)
+        prompt = self.prompt_template.format(error_message=error_message,
+                                             involved_object=src_kind)
+        self.locator.add_message(prompt)
+        self.locator.run_assistant(gen=gen)
+        messages = self.locator.wait_get_last_k_message(1)
+        if messages is None:
+            raise RuntimeError(
+                f"reduced-budget locator run ended in state "
+                f"{self.locator.get_run_status().status}")
+        plan = extract_json(messages.data[0].content[0].text.value)
+        plan["DestinationKind"]        # missing key -> next rung
+        return plan, 1
+
     # ------------------------------------------------------------ stage 2
 
     def compile_and_run(self, metapath_str: str, error_message: str,
@@ -167,10 +205,41 @@ class RCAPipeline:
         t0 = time.time()
         if self.cfg.fresh_threads:
             self.reset_threads()
+        res = self.resilience
+        if res is not None:
+            res.begin_incident()
         result: IncidentResult = {"error_message": error_message}
         with METRICS.timer("rca.incident"):
-            src_kind = locator.find_srcKind(self.state_executor, error_message)
-            plan, attempts = self.plan_destination(error_message, src_kind)
+            # stage 1 runs the degradation ladder under a resilience
+            # policy: full engine run (which already retries with
+            # feedback) -> ONE reduced-budget attempt -> scripted-oracle
+            # plan -> (srcKind only) the Pod default.  Every rung drop is
+            # annotated in result["degraded"].
+            if res is None:
+                src_kind = locator.find_srcKind(self.state_executor,
+                                                error_message)
+                plan, attempts = self.plan_destination(error_message,
+                                                       src_kind)
+            else:
+                from k8s_llm_rca_tpu.rca.oracle import scripted_plan
+
+                src_kind = res.ladder("locate.srcKind", [
+                    ("full", lambda: locator.find_srcKind(
+                        self.state_executor, error_message)),
+                    # the stategraph is down/degraded: Pod is the kind
+                    # every incident fixture's Event hangs off, the least
+                    # wrong starting point a blind planner can pick
+                    ("default-Pod", lambda: "Pod"),
+                ])
+                plan, attempts = res.ladder("locate.plan", [
+                    ("full", lambda: self.plan_destination(error_message,
+                                                           src_kind)),
+                    ("reduced-budget", lambda: self._plan_reduced(
+                        error_message, src_kind)),
+                    ("scripted-oracle", lambda: (scripted_plan(
+                        error_message, src_kind, self.native_kinds,
+                        self.external_kinds), 0)),
+                ])
             result["locator_attempts"] = attempts
 
             dest_kind = plan["DestinationKind"]
@@ -179,17 +248,33 @@ class RCAPipeline:
             intermediate = [x for x in relevant
                             if x not in (src_kind, dest_kind) and x in known]
 
-            metapaths = locator.find_metapath(
-                self.meta_executor, src_kind, dest_kind, intermediate,
-                self.cfg.metapath_max_hops)
+            def _metapaths():
+                return locator.find_metapath(
+                    self.meta_executor, src_kind, dest_kind, intermediate,
+                    self.cfg.metapath_max_hops)
+
+            if res is None:
+                metapaths = _metapaths()
+            else:
+                metapaths = res.ladder("locate.metapath", [
+                    ("full", _metapaths),
+                    ("skipped", lambda: []),
+                ])
 
             result["analysis"] = []
             for metapath in metapaths:
                 metapath_str = cyphergen.extend_metapath_construct_string(
                     metapath)
                 analysis: Dict[str, Any] = {"extend_metapath": metapath_str}
-                records = self.compile_and_run(metapath_str, error_message,
-                                               analysis)
+                if res is None:
+                    records = self.compile_and_run(metapath_str,
+                                                   error_message, analysis)
+                else:
+                    records = res.ladder("cypher", [
+                        ("full", lambda: self.compile_and_run(
+                            metapath_str, error_message, analysis)),
+                        ("skipped", lambda: []),
+                    ])
                 if self.reranker is not None and len(records) > 1:
                     top_k = self.cfg.rerank_top_k or None
                     ranked = self.reranker.rerank_records(
@@ -198,15 +283,27 @@ class RCAPipeline:
                     analysis["rerank_scores"] = [s for _, s in ranked]
                 analysis["statepath"] = []
                 for record in records:
-                    report, clues = auditor.check_statepath(
-                        self.state_executor, self.analyzer, record,
-                        concurrent=self.cfg.concurrent_audits,
-                        reranker=self.reranker,
-                        fields_top_k=self.cfg.rerank_fields_top_k)
+                    def _audit(record=record):
+                        return auditor.check_statepath(
+                            self.state_executor, self.analyzer, record,
+                            concurrent=self.cfg.concurrent_audits,
+                            reranker=self.reranker,
+                            fields_top_k=self.cfg.rerank_fields_top_k)
+
+                    if res is None:
+                        report, clues = _audit()
+                    else:
+                        report, clues = res.ladder("audit", [
+                            ("full", _audit),
+                            ("skipped", lambda: (
+                                None, {"degraded": "audit skipped"})),
+                        ])
                     analysis["statepath"].append(
                         {"report": report, "clue": clues})
                 result["analysis"].append(analysis)
 
+        if res is not None:
+            result["degraded"] = res.incident_snapshot()
         t1 = time.time()
         result["time_cost"] = t1 - t0
         result["token_usage"] = self.window_token_usage(int(t0), int(t1) + 1)

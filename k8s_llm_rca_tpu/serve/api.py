@@ -152,9 +152,15 @@ def _locked(fn):
 class AssistantService:
     """The 'server': owns assistants/threads/runs and drives an LMBackend."""
 
-    def __init__(self, backend: LMBackend, run_timeout_s: float = 600.0):
+    def __init__(self, backend: LMBackend, run_timeout_s: float = 600.0,
+                 clock=None):
+        # ``clock``: injectable time source (time()/sleep()) for run
+        # timestamps and deadlines — the real ``time`` module by default,
+        # a faults.plan.VirtualClock under chaos runs so deadline expiry
+        # happens after a deterministic number of pumps, not wall seconds
         self.backend = backend
         self.run_timeout_s = run_timeout_s
+        self._clock = clock if clock is not None else time
         self.assistants: Dict[str, Assistant] = {}
         self.threads: Dict[str, Thread] = {}
         self.runs: Dict[str, Run] = {}
@@ -207,9 +213,9 @@ class AssistantService:
                    gen: Optional[GenOptions] = None) -> Run:
         assistant = self.assistants[assistant_id]
         run = Run(self._next_id("run"), thread_id, assistant_id,
-                  created_at=int(time.time()),
+                  created_at=int(self._clock.time()),
                   instructions_override=instructions)
-        run.deadline = time.time() + self.run_timeout_s
+        run.deadline = self._clock.time() + self.run_timeout_s
         self.runs[run.id] = run
         self._thread_runs[thread_id].append(run.id)
 
@@ -234,7 +240,7 @@ class AssistantService:
         if run.status not in RunStatus.TERMINAL:
             self.backend.cancel(run.backend_handle)
             run.status = RunStatus.CANCELLED
-            run.completed_at = int(time.time())
+            run.completed_at = int(self._clock.time())
             self._inflight.pop(run.backend_handle, None)
         return run
 
@@ -286,7 +292,7 @@ class AssistantService:
         """Advance the backend and settle any finished runs.  O(in-flight
         runs), not O(all runs ever created)."""
         results = self.backend.pump()
-        now = time.time()
+        now = self._clock.time()
         for handle, run_id in list(self._inflight.items()):
             run = self.runs[run_id]
             if handle in results:
@@ -307,12 +313,12 @@ class AssistantService:
                 run.usage["completion_tokens"] = res.completion_tokens
                 run.usage["total_tokens"] = (
                     run.usage["prompt_tokens"] + res.completion_tokens)
-                run.completed_at = int(time.time())
+                run.completed_at = int(self._clock.time())
                 del self._inflight[handle]
             elif run.deadline is not None and now > run.deadline:
                 self.backend.cancel(run.backend_handle)
                 run.status = RunStatus.EXPIRED
-                run.completed_at = int(time.time())
+                run.completed_at = int(self._clock.time())
                 del self._inflight[handle]
 
     def wait_run(self, run_id: str, timeout_s: Optional[float] = None) -> Run:
@@ -320,7 +326,7 @@ class AssistantService:
         # whole wait, so concurrent waiters interleave — each tick one of
         # them drives decodes EVERY in-flight run forward
         run = self.runs[run_id]
-        t0 = time.time()
+        t0 = self._clock.time()
         with self._lock:               # += is not atomic across threads
             self._waiters += 1
         try:
@@ -343,7 +349,7 @@ class AssistantService:
                     run.status = RunStatus.FAILED
                     run.error = "backend dropped the run"
                     break
-                if timeout_s is not None and time.time() - t0 > timeout_s:
+                if timeout_s is not None and self._clock.time() - t0 > timeout_s:
                     # mirror _pump's deadline path: cancel the backend run
                     # and drop it from _inflight, else the abandoned run
                     # keeps occupying a batch slot and a peer worker's
@@ -351,7 +357,7 @@ class AssistantService:
                     self.backend.cancel(run.backend_handle)
                     self._inflight.pop(run.backend_handle, None)
                     run.status = RunStatus.EXPIRED
-                    run.completed_at = int(time.time())
+                    run.completed_at = int(self._clock.time())
                     break
             # with PEER waiters, a REAL sleep (not sleep(0)): lock release
             # does not hand off — this thread would re-acquire before a

@@ -19,10 +19,11 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Protocol, Tuple
+from typing import Dict, Optional, Protocol, Set, Tuple
 
 from k8s_llm_rca_tpu.engine.constrain import make_grammar
 from k8s_llm_rca_tpu.engine.engine import InferenceEngine
+from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
 
@@ -67,18 +68,86 @@ class LMBackend(Protocol):
     def count_tokens(self, text: str) -> int: ...
 
 
+def _assert_fully_addressable(engine) -> None:
+    """The engine's threaded serving driver (EngineBackend under worker
+    threads, e.g. bench_rca_p50_engine) has nondeterministic tick
+    interleaving, while ``host_np``'s process_allgather path requires every
+    process to issue identical host syncs in identical order — driving a
+    process-spanning mesh through this backend would misalign the
+    collective and hang/corrupt all processes.  Multi-process meshes must
+    use a deterministic single-threaded SPMD driver instead
+    (tests/test_distributed.py); fail loudly at construction."""
+    import jax
+
+    leaves = list(jax.tree.leaves(engine.params))
+    cache = getattr(engine, "cache", None)
+    if cache is None:
+        cache = getattr(engine, "pool", None)
+    if cache is not None:
+        leaves += jax.tree.leaves(cache)
+    for leaf in leaves:
+        if not getattr(leaf, "is_fully_addressable", True):
+            raise ValueError(
+                "EngineBackend requires a fully-addressable engine mesh: "
+                "an array spans non-addressable devices (multi-process "
+                "mesh), and this backend's threaded drivers tick the "
+                "engine in nondeterministic order, which would misalign "
+                "host_np's process_allgather across the cluster.  Drive "
+                "multi-process meshes with a deterministic single-"
+                "threaded SPMD loop instead (see engine.host_np and "
+                "tests/test_distributed.py).")
+
+
 class EngineBackend:
-    """Continuous-batching engine behind the assistants API."""
+    """Continuous-batching engine behind the assistants API.
+
+    Fault injection (faults/inject.py): when a plan is armed, every
+    ``start`` polls ``SITE_BACKEND`` — "error" fails the run at the next
+    pump, "budget" raises BudgetError at submission, "stall" accepts the
+    run but never progresses it (a hung engine), so only the serve-layer
+    deadline ends it; ``cancel`` then reaps it.  Cancelling any live run
+    retires its engine sequence immediately (``EngineBase.cancel_seq``),
+    freeing its batch slot and — on the paged engine — its pages.
+    """
 
     def __init__(self, engine: InferenceEngine):
+        _assert_fully_addressable(engine)
         self.engine = engine
         self.tokenizer = engine.tokenizer
         self._handles = itertools.count()
         self._seq_to_handle: Dict[int, int] = {}
+        self._handle_seq: Dict[int, int] = {}
         self._opts: Dict[int, GenOptions] = {}
         self._live: Dict[int, bool] = {}
+        self._failed: Dict[int, str] = {}    # injected run failures
+        self._stalled: Set[int] = set()      # injected stalls (no result)
 
     def start(self, prompt: str, opts: GenOptions) -> int:
+        fault = None
+        if inject._ARMED is not None:
+            fault = inject._ARMED.poll(inject.SITE_BACKEND)
+        if fault is not None and fault.kind == "budget":
+            raise BudgetError(
+                f"injected budget fault at {fault.site}[{fault.index}]: "
+                f"no valid output exists under this budget")
+        if fault is not None and fault.kind == "error":
+            # the run "fails" engine-side: surfaces as BackendResult.error
+            # at the next pump, which the service maps to status=failed
+            handle = next(self._handles)
+            self._failed[handle] = (
+                f"injected engine-run failure at "
+                f"{fault.site}[{fault.index}]")
+            self._live[handle] = True
+            return handle
+        if fault is not None and fault.kind == "stall":
+            # a hung run: accepted, never progressed — stays busy until
+            # the serve-layer deadline cancels it.  Nothing is submitted
+            # to the engine, so the stall cannot perturb tick counts (the
+            # soak's byte-identity depends on that)
+            handle = next(self._handles)
+            self._stalled.add(handle)
+            self._live[handle] = True
+            return handle
         handle = next(self._handles)
         ids = self.tokenizer.encode(prompt + opts.forced_prefix, add_bos=True)
         grammar = make_grammar(opts.grammar, self.tokenizer,
@@ -109,18 +178,29 @@ class EngineBackend:
             ids, max_new_tokens=opts.max_new_tokens, stop_strings=stop,
             grammar=grammar)
         self._seq_to_handle[seq_id] = handle
+        self._handle_seq[handle] = seq_id
         self._opts[handle] = opts
         self._live[handle] = True
         return handle
 
     def pump(self) -> Dict[int, BackendResult]:
         results: Dict[int, BackendResult] = {}
+        for handle in list(self._failed):
+            msg = self._failed.pop(handle)
+            if self._live.pop(handle, False):
+                results[handle] = BackendResult("", 0, error=msg)
+        if self._stalled and inject._ARMED is not None:
+            # a stalled run only ends via the serve deadline; advance the
+            # plan's virtual clock so that deadline arrives after a
+            # DETERMINISTIC number of pumps instead of wall seconds
+            inject._ARMED.clock.sleep(0.05)
         if not self.engine.has_work:
             return results
         for res in self.engine.step():
             handle = self._seq_to_handle.pop(res.seq_id, None)
             if handle is None:
                 continue
+            self._handle_seq.pop(handle, None)
             opts = self._opts.pop(handle, GenOptions())
             live = self._live.pop(handle, False)
             if not live:                   # cancelled: drop, don't leak
@@ -136,10 +216,20 @@ class EngineBackend:
         return self._live.get(handle, False)
 
     def cancel(self, handle: int) -> None:
-        # the engine slot keeps decoding until its natural end; the result is
-        # simply dropped.  (Slot-level preemption lands with the paged cache.)
-        if handle in self._live:
-            self._live[handle] = False
+        # abort for real: the engine sequence retires NOW (the paged
+        # engine frees its pages through the normal _retire path), so an
+        # expired/cancelled run cannot leak allocator blocks or keep
+        # occupying a batch slot
+        if handle not in self._live and handle not in self._failed:
+            return
+        self._failed.pop(handle, None)
+        self._stalled.discard(handle)
+        self._live.pop(handle, None)
+        self._opts.pop(handle, None)
+        seq_id = self._handle_seq.pop(handle, None)
+        if seq_id is not None:
+            self._seq_to_handle.pop(seq_id, None)
+            self.engine.cancel_seq(seq_id)
 
     def count_tokens(self, text: str) -> int:
         return self.tokenizer.count(text)

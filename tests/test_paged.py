@@ -763,3 +763,60 @@ class TestBatchedPrefixHitAdmission:
         res = eng.generate([mk(i) for i in range(1, 9)], max_new_tokens=4)
         assert len(res) == 8
         eng.allocator.check()
+
+
+class TestEvictableAwareAdmissionCap:
+    """ADVICE low #2: the prefix-HIT group cap must count free pages PLUS
+    refcount-0 (evictable) prefix-cache pages — what _alloc_with_evict can
+    actually satisfy — so a hit wave under pool pressure admits in ONE
+    batched dispatch instead of splitting."""
+
+    def _engine(self):
+        cfg = TINY.replace(max_seq_len=64)
+        ecfg = EngineConfig(max_batch=8, max_seq_len=64, paged=True,
+                            page_size=8, num_pages=24,
+                            prefill_buckets=(16, 32), max_new_tokens=4,
+                            temperature=0.0, decode_chunk=1,
+                            prefix_cache=True)
+        tok = get_tokenizer(vocab_size=cfg.vocab_size)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        return PagedInferenceEngine(cfg, ecfg, params, tok,
+                                    use_kernel=False), tok
+
+    def test_hit_wave_under_pool_pressure_forms_one_group(self):
+        eng, tok = self._engine()
+        rng = np.random.default_rng(5)
+        prefix = list(rng.integers(1, 400, 16).astype(int))   # 2 full pages
+
+        # seed the prefix chain (24-token prompt: 3 full pages chained)
+        eng.generate([prefix + list(rng.integers(1, 400, 8).astype(int))],
+                     max_new_tokens=2)
+        # evictable ballast: a long unrelated prompt chains 6 more pages
+        eng.generate([list(rng.integers(1, 400, 48).astype(int))],
+                     max_new_tokens=2)
+        evictable = eng.prefix_cache.n_evictable
+        assert evictable >= 7                       # 1 (3rd P page) + 6 (Q)
+
+        # drain the free list to 2 pages: per-member suffix needs 2 pages,
+        # so the OLD free-only cap would be max(1, 2 // 2) = 1 (split into
+        # single admits) while free+evictable serves the whole wave of 4
+        drain = eng.allocator.n_free - 2
+        held = eng.allocator.alloc(drain, owner=999)
+        wave = [prefix + list(rng.integers(1, 400, 8).astype(int))
+                for _ in range(4)]
+        for w in wave:
+            eng.submit(w, max_new_tokens=2)
+
+        hits0 = METRICS.count("engine.prefix_batch_hit_admissions")
+        dispatches0 = METRICS.snapshot().get("engine.prefill.count", 0.0)
+        done = eng.step()                           # admission tick
+        assert METRICS.count("engine.prefix_batch_hit_admissions") \
+            - hits0 == 4, "hit wave split instead of admitting as one group"
+        assert METRICS.snapshot().get("engine.prefill.count", 0.0) \
+            - dispatches0 == 1, "hit wave took more than one prefill dispatch"
+
+        results = {r.seq_id: r
+                   for r in list(done) + eng.run_to_completion()}
+        assert len(results) == 4
+        eng.allocator.free(held, owner=999)
+        eng.allocator.check()
