@@ -39,6 +39,8 @@ from k8s_llm_rca_tpu.engine.sampling import (
 )
 from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.obs import trace as obs_trace
+from k8s_llm_rca_tpu.runtime import profiling
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
@@ -560,8 +562,67 @@ class EngineBase:
                 allow[slot] = c.allow
         return forced, allow
 
+    # -------------------------------------------------- tick + observability
+
+    # per-engine mirror of the engine.* METRICS counters (lazily created):
+    # the tick timeline reads THIS, not the process-global METRICS, so a
+    # traced run's gauges are a pure function of the engine's own activity
+    # even when METRICS carries other engines'/tests' history
+    _counts: Optional[Dict[str, float]] = None
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        """Increment a counter in METRICS and this engine's private
+        mirror (both cheap; the mirror is a plain dict add)."""
+        METRICS.inc(name, value)
+        c = self._counts
+        if c is None:
+            c = self._counts = {}
+        c[name] = c.get(name, 0.0) + value
+
     def step(self) -> List[SequenceResult]:
+        """One engine tick (the public pump surface): apply this tick's
+        scheduled fault, run the subclass tick body (``_tick``), and —
+        only when a tracer is active — wrap the tick in an
+        ``engine.tick`` span and record a TickSample of the scheduler/
+        pool gauges.  The untraced, disarmed hot path pays exactly two
+        module-slot identity checks."""
+        if inject._ARMED is not None:          # disarmed cost: this check
+            self._tick_fault()
+        tr = obs_trace._ACTIVE
+        if tr is None:                         # untraced cost: this check
+            return self._tick()
+        with tr.span("engine.tick", cat="engine"):
+            finished = self._tick()
+        self._record_tick(tr)
+        return finished
+
+    def _tick(self) -> List[SequenceResult]:
         raise NotImplementedError
+
+    def _tick_gauges(self) -> Dict[str, Optional[int]]:
+        """Scheduler gauges for the tick timeline; the paged engine
+        overrides to add pool pressure (free/evictable pages)."""
+        return {"running": len(self._active),
+                "queued": len(self._pending),
+                "free_pages": None, "evictable_pages": None}
+
+    def _record_tick(self, tr) -> None:
+        from k8s_llm_rca_tpu.obs.timeline import TickSample
+
+        g = self._tick_gauges()
+        c = self._counts or {}
+        tl = tr.timeline
+        tl.record(TickSample(
+            tick=tl.total, ts=tr.now(),
+            running=g["running"], queued=g["queued"],
+            free_pages=g["free_pages"],
+            evictable_pages=g["evictable_pages"],
+            prefill_tokens=c.get("engine.prefill_tokens", 0.0),
+            decode_tokens=c.get("engine.decode_tokens", 0.0),
+            prefix_hit_tokens=c.get("engine.prefix_hit_tokens", 0.0),
+            preemptions=c.get("engine.preemptions", 0.0),
+            admission_rejections=c.get("engine.admission_rejections",
+                                       0.0)))
 
     # ---------------------------------------- chunked scan tick (shared)
 
@@ -756,7 +817,7 @@ class EngineBase:
                 reason = self._finish_reason(st, token, base_len + j)
                 if reason is not None:
                     break
-            METRICS.inc("engine.decode_tokens", committed)
+            self._count("engine.decode_tokens", committed)
             if reason is not None:
                 finished.append(self._retire(slot, reason))
         return finished
@@ -947,9 +1008,9 @@ class EngineBase:
                             and token == draft[j])
                 if not accepted:
                     break
-            METRICS.inc("engine.decode_tokens", len(committed))
-            METRICS.inc("engine.spec_drafted", len(draft))
-            METRICS.inc("engine.spec_accepted", max(0, len(committed) - 1))
+            self._count("engine.decode_tokens", len(committed))
+            self._count("engine.spec_drafted", len(draft))
+            self._count("engine.spec_accepted", max(0, len(committed) - 1))
             if reason is not None:
                 finished.append(self._retire(slot, reason))
             elif self._draft is not None:
@@ -1278,11 +1339,11 @@ class InferenceEngine(EngineBase):
     def _register(self, seq_id: int, prompt_ids: List[int]) -> None:
         self._prompts[seq_id] = list(prompt_ids)
 
-    def step(self) -> List[SequenceResult]:
+    def _tick(self) -> List[SequenceResult]:
         """One engine tick: admit pending into free slots, then one decode
-        step for all active slots.  Returns sequences finished this tick."""
-        if inject._ARMED is not None:          # disarmed cost: this check
-            self._tick_fault()
+        step for all active slots.  Returns sequences finished this tick.
+        (Fault polling and tracing live in EngineBase.step, the public
+        pump surface.)"""
         finished: List[SequenceResult] = []
         while self._pending and self._free_slots:
             group = self._admission_group()
@@ -1311,7 +1372,7 @@ class InferenceEngine(EngineBase):
         forced, allow = self._tick_constraints(
             active_slots, self.engine_cfg.max_batch,
             self.model_cfg.vocab_size)
-        with METRICS.timer("engine.decode_step"):
+        with profiling.annotate("engine.decode_step"):
             self.cache, logits = self._decode(
                 self.model_cfg, self.params, self.cache,
                 self.cur_tokens, self.lengths)
@@ -1321,7 +1382,7 @@ class InferenceEngine(EngineBase):
                     logits, sub, self.sampling, jnp.asarray(allow))
             else:
                 next_tokens = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.decode_tokens", len(self._active))
+        self._count("engine.decode_tokens", len(self._active))
 
         self.lengths = self.lengths.at[jnp.asarray(active_slots)].add(1)
         if forced:
@@ -1361,13 +1422,13 @@ class InferenceEngine(EngineBase):
         assert n <= bucket, f"prompt {n} exceeds largest bucket {bucket}"
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req.prompt_ids
-        with METRICS.timer("engine.prefill"):
+        with profiling.annotate("engine.prefill"):
             self.cache, logits = self._prefill(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot))
             self._key, sub = jax.random.split(self._key)
             first = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.prefill_tokens", n)
+        self._count("engine.prefill_tokens", n)
         return self._activate(req, slot, logits, int(host_np(first)[0]))
 
     def _activate(self, req: _Pending, slot: int, logits_1v,
@@ -1436,15 +1497,15 @@ class InferenceEngine(EngineBase):
         lens[n:] = lens[n - 1]
         slot_arr[n:] = slot_arr[n - 1]
 
-        with METRICS.timer("engine.prefill"):
+        with profiling.annotate("engine.prefill"):
             self.cache, logits = self._prefill_batch(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(tokens), jnp.asarray(lens),
                 jnp.asarray(slot_arr))
             self._key, sub = jax.random.split(self._key)
             firsts = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.prefill_tokens", int(lens[:n].sum()))
-        METRICS.inc("engine.batched_admissions", n)
+        self._count("engine.prefill_tokens", int(lens[:n].sum()))
+        self._count("engine.batched_admissions", n)
 
         finished: List[SequenceResult] = []
         firsts_host = host_np(firsts)
@@ -1480,7 +1541,7 @@ class InferenceEngine(EngineBase):
         setup = self._scan_dfa_setup()
         self._key, sub = jax.random.split(self._key)
         if setup is None:
-            with METRICS.timer("engine.decode_step"):
+            with profiling.annotate("engine.decode_step"):
                 self.cache, toks, self.lengths = self._decode_scan(
                     self.model_cfg, self.params, self.cache,
                     self.cur_tokens, self.lengths, sub, chunk,
@@ -1488,7 +1549,7 @@ class InferenceEngine(EngineBase):
         else:
             (allow_t, next_t, dist_t, close_t, complete_t), states, \
                 remaining = setup
-            with METRICS.timer("engine.decode_step"):
+            with profiling.annotate("engine.decode_step"):
                 self.cache, toks, self.lengths, _ = self._decode_scan_dfa(
                     self.model_cfg, self.params, self.cache,
                     self.cur_tokens, self.lengths, sub, chunk,
@@ -1513,7 +1574,7 @@ class InferenceEngine(EngineBase):
         cur_host = host_np(self.cur_tokens)
         tokens_in, drafts = self._build_drafts(active_slots, cur_host)
 
-        with METRICS.timer("engine.decode_step"):
+        with profiling.annotate("engine.decode_step"):
             self.cache, greedy, logits = self._decode_multi(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(tokens_in), self.lengths)

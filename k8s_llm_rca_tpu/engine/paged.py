@@ -54,6 +54,7 @@ from k8s_llm_rca_tpu.ops.paged_attention import (
 )
 from k8s_llm_rca_tpu.engine.prefix import PrefixCache
 from k8s_llm_rca_tpu.ops.rope import rope_frequencies
+from k8s_llm_rca_tpu.runtime import profiling
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
@@ -1277,9 +1278,76 @@ class PagedInferenceEngine(EngineBase):
         else:
             super()._apply_tick_fault(fault, plan)
 
-    def step(self) -> List[SequenceResult]:
-        if inject._ARMED is not None:          # disarmed cost: this check
-            self._tick_fault()
+    # ---------------------------------------------------- observability
+
+    def _tick_gauges(self):
+        """Pool-pressure gauges for the tick timeline (obs/timeline.py):
+        free pages from the allocator, evictable pages from the prefix
+        cache's refcount-0 residency."""
+        g = super()._tick_gauges()
+        g["free_pages"] = self.allocator.n_free
+        g["evictable_pages"] = (self.prefix_cache.n_evictable
+                                if self.prefix_cache is not None else 0)
+        return g
+
+    def _tick(self) -> List[SequenceResult]:
+        finished: List[SequenceResult] = []
+        if self._pending and self._free_slots:
+            with profiling.annotate("engine.tick.admission"):
+                finished.extend(self._tick_admission())
+        if not self._active:
+            return finished
+
+        with profiling.annotate("engine.tick.eviction"):
+            self._tick_growth()
+        active_slots = sorted(self._active)
+        if not active_slots:
+            return finished
+
+        if self._speculation_applies():
+            finished.extend(self._speculative_tick(active_slots))
+            return finished
+
+        chunk = self._scan_chunk()
+        if chunk > 1:
+            finished.extend(self._scan_tick(chunk, active_slots))
+            return finished
+
+        forced, allow = self._tick_constraints(
+            active_slots, self.engine_cfg.max_batch,
+            self.model_cfg.vocab_size)
+        with profiling.annotate("engine.decode_step"):
+            self.pool, logits = self._decode(
+                self.model_cfg, self.params, self.pool,
+                jnp.asarray(self.cur_tokens, jnp.int32),
+                jnp.asarray(self.lengths, jnp.int32),
+                jnp.asarray(self.block_tables),
+                use_kernel=self.use_kernel)
+            self._key, sub = jax.random.split(self._key)
+            if allow is not None:
+                next_tokens = self._sample_masked(
+                    logits, sub, self.sampling, jnp.asarray(allow))
+            else:
+                next_tokens = self._sample(logits, sub, self.sampling)
+        self._count("engine.decode_tokens", len(active_slots))
+
+        host_next = host_np(next_tokens)
+        for slot in active_slots:
+            self.lengths[slot] += 1
+            st = self._active[slot]
+            token = forced.get(slot, int(host_next[slot]))
+            self.cur_tokens[slot] = token
+            st.generated.append(token)
+            if st.grammar is not None:
+                st.grammar.advance(token)
+            reason = self._finish_reason(st, token, int(self.lengths[slot]))
+            if reason is not None:
+                finished.append(self._retire(slot, reason))
+        return finished
+
+    def _tick_admission(self) -> List[SequenceResult]:
+        """Admit pending requests into free slots (the tick's admission
+        phase, annotated for XProf/flight records)."""
         finished: List[SequenceResult] = []
         while self._pending and self._free_slots:
             group, matches = self._admission_group()
@@ -1304,12 +1372,13 @@ class PagedInferenceEngine(EngineBase):
                 # requeued at the front).  Wait for retirements to free
                 # pages; only the growth path below preempts, because a
                 # sequence that cannot grow cannot make progress at all.
+                self._count("engine.admission_rejections")
                 break
             del self._pending[:len(group)]
             finished.extend(admitted)
-        if not self._active:
-            return finished
+        return finished
 
+    def _tick_growth(self) -> None:
         # grow block tables to cover this tick's scan window: the
         # per-step KV write indexes the table dynamically (lengths //
         # page via take_along_axis), so pages pre-allocated for
@@ -1367,50 +1436,6 @@ class PagedInferenceEngine(EngineBase):
                     except OutOfPages:
                         break          # best-effort: bound shrinks instead
                     self.block_tables[slot, idx] = page
-        active_slots = sorted(self._active)
-        if not active_slots:
-            return finished
-
-        if self._speculation_applies():
-            finished.extend(self._speculative_tick(active_slots))
-            return finished
-
-        chunk = self._scan_chunk()
-        if chunk > 1:
-            finished.extend(self._scan_tick(chunk, active_slots))
-            return finished
-
-        forced, allow = self._tick_constraints(
-            active_slots, self.engine_cfg.max_batch,
-            self.model_cfg.vocab_size)
-        with METRICS.timer("engine.decode_step"):
-            self.pool, logits = self._decode(
-                self.model_cfg, self.params, self.pool,
-                jnp.asarray(self.cur_tokens, jnp.int32),
-                jnp.asarray(self.lengths, jnp.int32),
-                jnp.asarray(self.block_tables),
-                use_kernel=self.use_kernel)
-            self._key, sub = jax.random.split(self._key)
-            if allow is not None:
-                next_tokens = self._sample_masked(
-                    logits, sub, self.sampling, jnp.asarray(allow))
-            else:
-                next_tokens = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.decode_tokens", len(active_slots))
-
-        host_next = host_np(next_tokens)
-        for slot in active_slots:
-            self.lengths[slot] += 1
-            st = self._active[slot]
-            token = forced.get(slot, int(host_next[slot]))
-            self.cur_tokens[slot] = token
-            st.generated.append(token)
-            if st.grammar is not None:
-                st.grammar.advance(token)
-            reason = self._finish_reason(st, token, int(self.lengths[slot]))
-            if reason is not None:
-                finished.append(self._retire(slot, reason))
-        return finished
 
     # --------------------------------------------- speculative decoding
 
@@ -1428,7 +1453,7 @@ class PagedInferenceEngine(EngineBase):
         sharing one compiled DFA verify constrained ON DEVICE
         (engine.dfa_greedy_multi) — no [B, T, V] logits transfer."""
         tokens_in, drafts = self._build_drafts(active_slots, self.cur_tokens)
-        with METRICS.timer("engine.decode_step"):
+        with profiling.annotate("engine.decode_step"):
             self.pool, greedy, logits = self._decode_multi(
                 self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens_in), jnp.asarray(self.lengths, jnp.int32),
@@ -1467,7 +1492,7 @@ class PagedInferenceEngine(EngineBase):
         setup = self._scan_dfa_setup()
         self._key, sub = jax.random.split(self._key)
         if setup is None:
-            with METRICS.timer("engine.decode_step"):
+            with profiling.annotate("engine.decode_step"):
                 self.pool, toks, _ = self._decode_scan(
                     self.model_cfg, self.params, self.pool,
                     jnp.asarray(self.cur_tokens, jnp.int32),
@@ -1478,7 +1503,7 @@ class PagedInferenceEngine(EngineBase):
         else:
             (allow_t, next_t, dist_t, close_t, complete_t), states, \
                 remaining = setup
-            with METRICS.timer("engine.decode_step"):
+            with profiling.annotate("engine.decode_step"):
                 self.pool, toks, _, _ = self._decode_scan_dfa(
                     self.model_cfg, self.params, self.pool,
                     jnp.asarray(self.cur_tokens, jnp.int32),
@@ -1662,7 +1687,7 @@ class PagedInferenceEngine(EngineBase):
 
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(rest)] = rest
-        with METRICS.timer("engine.prefill"):
+        with profiling.annotate("engine.prefill"):
             if n_cached:
                 # pad the prefix table to the next power of two of page
                 # counts: the chunk-prefill gathers/attends over the whole
@@ -1678,7 +1703,7 @@ class PagedInferenceEngine(EngineBase):
                     jnp.asarray(padded), jnp.int32(len(rest)),
                     jnp.int32(n_cached), jnp.asarray(prefix_table),
                     jnp.asarray(table[n_cp:n_cp + n_pages]))
-                METRICS.inc("engine.prefix_hit_tokens", n_cached)
+                self._count("engine.prefix_hit_tokens", n_cached)
             else:
                 self.pool, logits = self._prefill(
                     self.model_cfg, self.params, self.pool,
@@ -1686,7 +1711,7 @@ class PagedInferenceEngine(EngineBase):
                     jnp.asarray(table[:n_pages]))
             self._key, sub = jax.random.split(self._key)
             first = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.prefill_tokens", len(rest))
+        self._count("engine.prefill_tokens", len(rest))
 
         return self._activate_paged(req, slot, table, n_cp, logits,
                                     int(host_np(first)[0]))
@@ -1785,7 +1810,7 @@ class PagedInferenceEngine(EngineBase):
         ptabs[n:] = ptabs[n - 1]
         maps[n:] = maps[n - 1]
 
-        with METRICS.timer("engine.prefill"):
+        with profiling.annotate("engine.prefill"):
             self.pool, logits = self._prefill_chunk_batch(
                 self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens), jnp.asarray(clens),
@@ -1793,10 +1818,10 @@ class PagedInferenceEngine(EngineBase):
                 jnp.asarray(maps))
             self._key, sub = jax.random.split(self._key)
             firsts = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.prefill_tokens",
+        self._count("engine.prefill_tokens",
                     sum(len(rest) for rest in rests))
-        METRICS.inc("engine.prefix_hit_tokens", n_cached * n)
-        METRICS.inc("engine.prefix_batch_hit_admissions", n)
+        self._count("engine.prefix_hit_tokens", n_cached * n)
+        self._count("engine.prefix_batch_hit_admissions", n)
 
         finished: List[SequenceResult] = []
         firsts_host = host_np(firsts)
@@ -1852,14 +1877,14 @@ class PagedInferenceEngine(EngineBase):
         lens[n:] = lens[n - 1]
         maps[n:] = maps[n - 1]
 
-        with METRICS.timer("engine.prefill"):
+        with profiling.annotate("engine.prefill"):
             self.pool, logits = self._prefill_batch(
                 self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens), jnp.asarray(lens), jnp.asarray(maps))
             self._key, sub = jax.random.split(self._key)
             firsts = self._sample(logits, sub, self.sampling)
-        METRICS.inc("engine.prefill_tokens", int(lens[:n].sum()))
-        METRICS.inc("engine.batched_admissions", n)
+        self._count("engine.prefill_tokens", int(lens[:n].sum()))
+        self._count("engine.batched_admissions", n)
 
         finished: List[SequenceResult] = []
         firsts_host = host_np(firsts)
@@ -1919,7 +1944,7 @@ class PagedInferenceEngine(EngineBase):
         remaining = max(1, st.max_new_tokens - len(st.generated))
         log.info("preempting seq %d (slot %d, %d tokens) to free pages",
                  st.seq_id, slot, len(resumed_prompt))
-        METRICS.inc("engine.preemptions", 1)
+        self._count("engine.preemptions", 1)
         # the grammar FSM rides along: its state already reflects every
         # generated token now baked into the resume prompt
         self._pending.insert(0, _Pending(

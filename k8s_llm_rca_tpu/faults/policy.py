@@ -27,6 +27,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -130,6 +131,10 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         self.failures = 0
+        if self.state != "closed":
+            # half_open probe succeeded (or an out-of-band success while
+            # open): the dependency recovered — a flight-record event
+            obs_trace.event("resilience.breaker_close", dep=self.name)
         self.state = "closed"
 
     def record_failure(self) -> None:
@@ -140,6 +145,8 @@ class CircuitBreaker:
                 self.opens += 1
                 log.warning("circuit %r opened after %d failures",
                             self.name, self.failures)
+                obs_trace.event("resilience.breaker_open", dep=self.name,
+                                failures=self.failures)
             self.state = "open"
             self._opened_at = self.clock.time()
 
@@ -202,6 +209,7 @@ class ResiliencePolicy:
 
     def _count_retry(self, _exc: BaseException) -> None:
         self.counters["retries"] += 1
+        obs_trace.event("resilience.retry", error=type(_exc).__name__)
 
     # ------------------------------------------------------------- ladder
 
@@ -222,6 +230,8 @@ class ResiliencePolicy:
                 self.degradations.append(
                     StageDegradation(stage, name, str(last)))
                 self.counters["degraded_stages"] += 1
+                obs_trace.event("resilience.degraded", stage=stage,
+                                rung=name)
             return out
         raise last if last is not None else RuntimeError(
             f"stage {stage}: empty ladder")

@@ -23,6 +23,7 @@ degraded-and-annotated (the ladder's bottom rungs are infallible).
 
 from __future__ import annotations
 
+import contextlib
 import json
 from typing import Any, Dict, List, Optional
 
@@ -94,13 +95,20 @@ def _build_oracle_service(run_timeout_s: float, clock):
 def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    backend: str = "engine",
                    plan_spec: Optional[Dict[str, Any]] = None,
-                   run_timeout_s: float = 1.5) -> Dict[str, Any]:
+                   run_timeout_s: float = 1.5,
+                   tracer: Optional[Any] = None) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
 
     ``backend``: "engine" (the real paged TINY engine — tick faults and
     stalls bite) or "oracle" (scripted backend — graph faults only; the
     cheap mode bench.py publishes alongside the engine soak).
+
+    ``tracer``: optional obs.Tracer — activated for the whole soak with
+    its clock REBOUND to the soak's VirtualClock, so every span/event
+    timestamp is virtual and the exported Chrome trace is byte-identical
+    run over run (the flight recorder's golden acceptance bar).  The
+    report then carries a deterministic ``flight`` summary.
     """
     from k8s_llm_rca_tpu.config import RCAConfig
     from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
@@ -134,9 +142,16 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                   analyzer_max_new_tokens=96, fresh_threads=True),
         resilience=policy)
 
+    obs_ctx: Any = contextlib.nullcontext()
+    if tracer is not None:
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        tracer.clock = clock          # virtual timestamps (see docstring)
+        obs_ctx = obs_trace.tracing(tracer)
+
     incidents: List[Dict[str, Any]] = []
     n_resolved = n_degraded = n_failed = 0
-    with inject.armed(plan):
+    with inject.armed(plan), obs_ctx:
         for i in range(n_incidents):
             message = INCIDENTS[i % len(INCIDENTS)].message
             row: Dict[str, Any] = {"error_message": message}
@@ -154,6 +169,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
             row["status"] = "degraded" if degraded else "resolved"
             row["degraded"] = degraded
             row["locator_attempts"] = result.get("locator_attempts")
+            if "flight" in result:    # traced soak: deterministic digest
+                row["flight"] = result["flight"]
             row["analyses"] = [
                 {"cypher_attempts": a.get("cypher_attempts"),
                  "used_fallback": "human_cypher_query" in a,
@@ -179,6 +196,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
         "virtual_elapsed_s": round(clock.time(), 6),
         "incidents": incidents,
     }
+    if tracer is not None:
+        report["flight"] = tracer.flight_summary()
     if engine is not None:
         # the chaos run must leave the engine clean: drained, allocator
         # invariants intact, no leaked pages beyond prefix-cache residency

@@ -20,6 +20,7 @@ from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.graph import cypher
 from k8s_llm_rca_tpu.graph.cypher import CypherSyntaxError  # noqa: F401 (re-export)
 from k8s_llm_rca_tpu.graph.store import Graph, Record
+from k8s_llm_rca_tpu.obs import trace as obs_trace
 
 
 class GraphQueryExecutor(Protocol):
@@ -39,13 +40,16 @@ class InMemoryGraphExecutor:
 
     def run_query(self, query: str,
                   parameters: Optional[Dict[str, Any]] = None) -> List[Record]:
-        if inject._ARMED is not None:
-            fault = inject._ARMED.poll(self.fault_site)
-            if fault is not None:
-                return inject.apply_query_fault(
-                    fault, inject._ARMED,
-                    lambda: cypher.run_query(self.graph, query, parameters))
-        return cypher.run_query(self.graph, query, parameters)
+        with obs_trace.span("graph.query", cat="graph",
+                            site=self.fault_site, query=query[:80]):
+            if inject._ARMED is not None:
+                fault = inject._ARMED.poll(self.fault_site)
+                if fault is not None:
+                    return inject.apply_query_fault(
+                        fault, inject._ARMED,
+                        lambda: cypher.run_query(self.graph, query,
+                                                 parameters))
+            return cypher.run_query(self.graph, query, parameters)
 
     def close(self) -> None:
         pass
@@ -70,13 +74,15 @@ class Neo4jQueryExecutor:
 
     def run_query(self, query: str,
                   parameters: Optional[Dict[str, Any]] = None):
-        if inject._ARMED is not None:
-            fault = inject._ARMED.poll(self.fault_site)
-            if fault is not None:
-                return inject.apply_query_fault(
-                    fault, inject._ARMED,
-                    lambda: self._run(query, parameters))
-        return self._run(query, parameters)
+        with obs_trace.span("graph.query", cat="graph",
+                            site=self.fault_site, query=query[:80]):
+            if inject._ARMED is not None:
+                fault = inject._ARMED.poll(self.fault_site)
+                if fault is not None:
+                    return inject.apply_query_fault(
+                        fault, inject._ARMED,
+                        lambda: self._run(query, parameters))
+            return self._run(query, parameters)
 
     def close(self) -> None:
         self.driver.close()

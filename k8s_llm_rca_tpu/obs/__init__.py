@@ -1,0 +1,24 @@
+"""End-to-end flight recorder: span tracing, engine tick timeline, and
+exporters (Chrome trace-event JSON + Prometheus text) across the
+RCA/serve/engine stack.
+
+- ``obs.trace`` — deterministic span tracer (injectable clock, bounded
+  store, module activation slot mirroring faults/inject.py) + the SITES
+  registry and its coverage self-check;
+- ``obs.timeline`` — per-engine-tick gauge samples in a bounded ring;
+- ``obs.export`` — Chrome trace (Perfetto-loadable, byte-stable under a
+  VirtualClock) and Prometheus text exposition renderers.
+
+See docs/observability.md for the capture/read workflow and the metric
+name registry.
+"""
+
+from k8s_llm_rca_tpu.obs.export import (   # noqa: F401
+    chrome_trace, chrome_trace_bytes, prometheus_text,
+    validate_chrome_trace,
+)
+from k8s_llm_rca_tpu.obs.timeline import TickSample, TickTimeline  # noqa: F401
+from k8s_llm_rca_tpu.obs.trace import (    # noqa: F401
+    SITES, Span, SpanEvent, Tracer, active, coverage_missing, event, span,
+    tracing,
+)

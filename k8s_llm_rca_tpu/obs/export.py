@@ -1,0 +1,245 @@
+"""Flight-recorder exporters: Chrome trace-event JSON and Prometheus text.
+
+Chrome export emits only complete ``X`` duration events (never split B/E
+pairs), instant ``i`` events, and ``C`` counter tracks from the tick
+timeline, all sorted by ``ts`` — the subset Perfetto loads without
+warnings and the simplest shape to validate (``validate_chrome_trace``).
+Timestamps are integer microseconds derived from the tracer's clock, so a
+VirtualClock soak exports byte-identical JSON run over run
+(``chrome_trace_bytes`` is the golden test's comparator).
+
+Prometheus export renders the text exposition format (version 0.0.4) over
+the global Metrics store plus optional live engine gauges: counters get a
+``_total`` suffix, phase timers become summaries (p50 quantile + _sum +
+_count), and HELP/TYPE headers are emitted exactly once per family with
+HELP text escaped per the spec.  ``AssistantService.prometheus_metrics``
+surfaces this through the serve API.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Set
+
+from k8s_llm_rca_tpu.obs.trace import Tracer
+
+_PREFIX = "k8s_llm_rca_"
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event JSON
+# ---------------------------------------------------------------------------
+
+
+def _us(t: float) -> int:
+    return int(round(t * 1e6))
+
+
+def _subtree(tracer: Tracer, root_id: int) -> Set[int]:
+    """Span ids in root's subtree (root included)."""
+    children: Dict[Optional[int], List[int]] = {}
+    for sp in tracer.spans:
+        children.setdefault(sp.parent_id, []).append(sp.span_id)
+    keep: Set[int] = set()
+    frontier = [root_id]
+    while frontier:
+        sid = frontier.pop()
+        keep.add(sid)
+        frontier.extend(children.get(sid, ()))
+    return keep
+
+
+def chrome_trace(tracer: Tracer, root: Optional[int] = None
+                 ) -> Dict[str, Any]:
+    """Trace-event JSON document for the whole recording, or for one
+    span's subtree (``root`` = span_id, e.g. a single rca.incident)."""
+    keep: Optional[Set[int]] = _subtree(tracer, root) if root is not None \
+        else None
+    events: List[Dict[str, Any]] = []
+    for sp in tracer.spans:
+        if keep is not None and sp.span_id not in keep:
+            continue
+        t1 = sp.t1 if sp.t1 is not None else sp.t0
+        args = dict(sp.args)
+        if sp.t1 is None:
+            args["unfinished"] = True
+        events.append({
+            "name": sp.name, "cat": sp.cat, "ph": "X",
+            "ts": _us(sp.t0), "dur": max(0, _us(t1) - _us(sp.t0)),
+            "pid": 1, "tid": sp.tid, "id": sp.span_id, "args": args,
+        })
+    for ev in tracer.events:
+        if keep is not None and (ev.parent_id is None
+                                 or ev.parent_id not in keep):
+            continue
+        events.append({
+            "name": ev.name, "cat": "event", "ph": "i", "s": "t",
+            "ts": _us(ev.ts), "pid": 1, "tid": ev.tid, "id": ev.event_id,
+            "args": dict(ev.args),
+        })
+    if keep is None:
+        for s in tracer.timeline.samples():
+            base = {"ph": "C", "ts": _us(s.ts), "pid": 1, "tid": 0}
+            events.append({**base, "name": "engine.seqs",
+                           "args": {"running": s.running,
+                                    "queued": s.queued}})
+            if s.free_pages is not None:
+                events.append({**base, "name": "engine.pages",
+                               "args": {"free": s.free_pages,
+                                        "evictable":
+                                        s.evictable_pages or 0}})
+            events.append({**base, "name": "engine.tokens",
+                           "args": {"prefill": s.prefill_tokens,
+                                    "decode": s.decode_tokens,
+                                    "prefix_hit": s.prefix_hit_tokens}})
+            events.append({**base, "name": "engine.sched",
+                           "args": {"preemptions": s.preemptions,
+                                    "admission_rejections":
+                                    s.admission_rejections}})
+    # stable sort: equal-ts events keep recording order, so the document
+    # is a pure function of the recording (byte-identity under VirtualClock)
+    events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"recorder": "k8s_llm_rca_tpu.obs",
+                         "dropped": tracer.dropped}}
+
+
+def chrome_trace_bytes(doc: Dict[str, Any]) -> bytes:
+    """Canonical bytes of a trace document (the golden-test comparator)."""
+    return json.dumps(doc, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def validate_chrome_trace(doc: Dict[str, Any]) -> int:
+    """Structural validation: sorted ``ts``, complete X events (non-negative
+    ``dur``), matched B/E if any ever appear, required keys per phase.
+    Returns the event count; raises ValueError on any violation."""
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("traceEvents missing or not a list")
+    last_ts = None
+    open_be: Dict[tuple, int] = {}
+    for i, ev in enumerate(events):
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            if key not in ev:
+                raise ValueError(f"event {i} missing {key!r}: {ev}")
+        if last_ts is not None and ev["ts"] < last_ts:
+            raise ValueError(
+                f"event {i} ts {ev['ts']} < previous {last_ts} (unsorted)")
+        last_ts = ev["ts"]
+        ph = ev["ph"]
+        if ph == "X":
+            if ev.get("dur", -1) < 0:
+                raise ValueError(f"X event {i} without non-negative dur")
+        elif ph == "B":
+            open_be[(ev["pid"], ev["tid"], ev["name"])] = \
+                open_be.get((ev["pid"], ev["tid"], ev["name"]), 0) + 1
+        elif ph == "E":
+            key = (ev["pid"], ev["tid"], ev["name"])
+            if open_be.get(key, 0) <= 0:
+                raise ValueError(f"E event {i} without matching B: {ev}")
+            open_be[key] -= 1
+        elif ph not in ("i", "C", "M"):
+            raise ValueError(f"event {i} has unsupported phase {ph!r}")
+    dangling = {k: v for k, v in open_be.items() if v}
+    if dangling:
+        raise ValueError(f"unmatched B events: {dangling}")
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+
+def _sanitize(name: str) -> str:
+    out = [c if (c.isalnum() or c in "_:") else "_" for c in name]
+    if out and out[0].isdigit():
+        out.insert(0, "_")
+    return "".join(out)
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class _Family:
+    """One metric family: HELP/TYPE emitted exactly once, then samples."""
+
+    def __init__(self, name: str, kind: str, help_text: str):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.samples: List[str] = []
+
+    def add(self, value: float, suffix: str = "",
+            labels: str = "") -> None:
+        self.samples.append(
+            f"{self.name}{suffix}{labels} {_fmt(value)}")
+
+    def render(self) -> str:
+        return "\n".join(
+            [f"# HELP {self.name} {_escape_help(self.help)}",
+             f"# TYPE {self.name} {self.kind}"] + self.samples)
+
+
+def prometheus_text(metrics=None, engine=None) -> str:
+    """Render the Metrics store (+ optional live engine gauges) as
+    Prometheus text exposition.  Counters -> ``<name>_total`` counter
+    families; phase timers -> summary families (p50 over the retained
+    reservoir window, exact _sum/_count); engine -> scheduler/pool gauges
+    (running/queued seqs, free/evictable pages, prefix-hit tokens)."""
+    if metrics is None:
+        from k8s_llm_rca_tpu.utils.logging import METRICS as metrics
+
+    families: Dict[str, _Family] = {}
+
+    def family(name: str, kind: str, help_text: str) -> _Family:
+        fam = families.get(name)
+        if fam is None:
+            fam = families[name] = _Family(name, kind, help_text)
+        return fam
+
+    with metrics._lock:
+        counters = dict(metrics.counters)
+        timings = {k: (v.total, v.count, list(v))
+                   for k, v in metrics.timings.items()}
+
+    for raw in sorted(counters):
+        name = f"{_PREFIX}{_sanitize(raw)}_total"
+        family(name, "counter", f"counter {raw!r}").add(counters[raw])
+    for raw in sorted(timings):
+        total, count, window = timings[raw]
+        name = f"{_PREFIX}{_sanitize(raw)}_seconds"
+        fam = family(name, "summary", f"phase timer {raw!r}")
+        if window:
+            ordered = sorted(window)
+            fam.add(ordered[len(ordered) // 2], labels='{quantile="0.5"}')
+        fam.add(total, suffix="_sum")
+        fam.add(count, suffix="_count")
+
+    if engine is not None:
+        gauges = {
+            "engine_running_seqs": len(getattr(engine, "_active", ())),
+            "engine_queued_seqs": len(getattr(engine, "_pending", ())),
+        }
+        allocator = getattr(engine, "allocator", None)
+        if allocator is not None:
+            gauges["engine_free_pages"] = allocator.n_free
+        prefix_cache = getattr(engine, "prefix_cache", None)
+        if prefix_cache is not None:
+            gauges["engine_evictable_pages"] = prefix_cache.n_evictable
+        counts = getattr(engine, "_counts", None) or {}
+        gauges["engine_prefix_hit_tokens"] = \
+            counts.get("engine.prefix_hit_tokens", 0.0)
+        for key in sorted(gauges):
+            family(f"{_PREFIX}{key}", "gauge",
+                   f"live engine gauge {key!r}").add(gauges[key])
+
+    return "\n".join(families[n].render()
+                     for n in sorted(families)) + "\n"

@@ -1,0 +1,63 @@
+"""Engine tick timeline: per-tick gauge samples in a bounded ring.
+
+One ``TickSample`` per engine tick while a tracer is active (EngineBase
+``step`` records it after the tick body): scheduler occupancy (running /
+queued sequences), paged-pool pressure (free vs evictable pages), and the
+engine's cumulative per-engine token counters (prefill vs decode tokens,
+prefix hits, preemptions, admission rejections).  Cumulative values —
+rather than per-tick deltas — keep samples cheap to record and are what
+Chrome/Perfetto counter tracks want; consumers diff endpoints
+(``flight_summary``) or plot the track directly.
+
+The ring is bounded (``capacity``) so an always-on recorder in a long
+soak keeps the newest window; ``total`` counts every tick ever recorded
+(exact, like Metrics counts), so dropping old samples never skews rates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class TickSample:
+    """Gauges at the end of one engine tick.  ``free_pages`` /
+    ``evictable_pages`` are None on the contiguous engine (no pool)."""
+
+    tick: int
+    ts: float
+    running: int
+    queued: int
+    free_pages: Optional[int] = None
+    evictable_pages: Optional[int] = None
+    prefill_tokens: float = 0.0
+    decode_tokens: float = 0.0
+    prefix_hit_tokens: float = 0.0
+    preemptions: float = 0.0
+    admission_rejections: float = 0.0
+
+
+class TickTimeline:
+    """Bounded ring of TickSamples; ``total`` is the exact tick count."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self.total = 0
+        self._ring: List[TickSample] = []
+        self._i = 0
+
+    def record(self, sample: TickSample) -> None:
+        if len(self._ring) < self.capacity:
+            self._ring.append(sample)
+        else:
+            self._ring[self._i] = sample
+            self._i = (self._i + 1) % self.capacity
+        self.total += 1
+
+    def samples(self) -> List[TickSample]:
+        """Retained samples in tick order (oldest first)."""
+        return self._ring[self._i:] + self._ring[:self._i]
+
+    def __len__(self) -> int:
+        return len(self._ring)

@@ -1,0 +1,307 @@
+"""Span tracer: the flight recorder's event source.
+
+The reference's only instrumentation is ``print`` banners and wall-clock
+bracketing (reference test_all.py:143-151); utils/logging.py upgraded that
+to flat counters/timers, but neither can answer "what did the engine do,
+tick by tick, while incident N's auditor stage was waiting?".  This module
+records the causal tree the stack actually executes:
+
+    rca.incident  (run id)
+      └─ rca.stage.locate / .metapath / .cypher / .audit
+           └─ serve.run  (one assistants-API run, explicit start/end)
+           └─ engine.tick
+                └─ engine.prefill / engine.decode_step (profiling.annotate)
+           └─ graph.query
+
+Design rules (mirroring faults/inject.py):
+
+- **always-on-cheap**: hot call sites guard on the module slot
+  ``trace._ACTIVE is not None`` (engine ticks) or call the ``span()`` /
+  ``event()`` helpers, which collapse to one global load + identity test
+  and a shared ``nullcontext`` when no tracer is active — nothing
+  allocates on the disarmed path;
+- **deterministic**: span/event ids come from a per-tracer counter, never
+  from object identity or randomness, and every timestamp is read from an
+  injectable ``clock`` (the real ``time`` module in production,
+  ``faults.plan.VirtualClock`` under chaos soaks) — so a seeded soak run
+  yields byte-identical Chrome trace JSON (obs/export.py), the golden
+  test's acceptance bar;
+- **bounded**: the span store is capped (``max_spans``); past the cap new
+  spans/events are counted in ``dropped`` instead of recorded, so an
+  always-on tracer cannot grow without bound in a long soak.
+
+``SITES`` is the registry of every name the in-tree instrumentation is
+expected to emit; ``coverage_missing()`` is the self-check tests invoke so
+instrumentation cannot silently rot (a renamed call site fails the test,
+not the dashboard).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from k8s_llm_rca_tpu.obs.timeline import TickTimeline
+
+# Every name the in-tree instrumentation emits (spans AND instant events).
+# tests/test_obs.py drives each layer and asserts coverage_missing() is
+# empty — add the site HERE when instrumenting a new call site.
+SITES = frozenset({
+    # engine layer (EngineBase.step + paged tick phases via annotate)
+    "engine.tick",
+    "engine.tick.admission",
+    "engine.prefill",
+    "engine.decode_step",
+    "engine.tick.eviction",
+    # serve layer
+    "serve.run_started",
+    "serve.run",
+    "serve.settled",
+    "backend.settled",
+    # graph layer
+    "graph.query",
+    # rca pipeline stages
+    "rca.incident",
+    "rca.stage.locate",
+    "rca.stage.metapath",
+    "rca.stage.cypher",
+    "rca.stage.audit",
+    # resilience events (faults/policy.py)
+    "resilience.retry",
+    "resilience.degraded",
+    "resilience.breaker_open",
+    "resilience.breaker_close",
+})
+
+
+@dataclass
+class SpanEvent:
+    """Instant event, optionally attached under a span (parent_id)."""
+
+    event_id: int
+    parent_id: Optional[int]
+    name: str
+    ts: float
+    tid: int
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Span:
+    span_id: int
+    parent_id: Optional[int]
+    name: str
+    cat: str
+    t0: float
+    tid: int
+    t1: Optional[float] = None
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+class Tracer:
+    """Deterministic span/event recorder with an injectable clock.
+
+    Thread-safe: the store mutates under one lock; the current-span stack
+    (parentage) is thread-local, so spans opened on worker threads parent
+    correctly within their own thread and never race another thread's
+    stack.  Thread ids are densified in first-seen order, which makes the
+    single-threaded soak's output reproducible (tid 1 everywhere).
+    """
+
+    def __init__(self, clock: Any = None, max_spans: int = 100_000):
+        self.clock = clock if clock is not None else _time
+        self.max_spans = max_spans
+        self.spans: List[Span] = []
+        self.events: List[SpanEvent] = []
+        self.dropped = 0
+        self.timeline = TickTimeline()
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._tids: Dict[int, int] = {}
+
+    # ------------------------------------------------------------ internals
+
+    def now(self) -> float:
+        return self.clock.time()
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = self._tids[ident] = len(self._tids) + 1
+        return tid
+
+    def _full(self) -> bool:
+        if len(self.spans) + len(self.events) >= self.max_spans:
+            self.dropped += 1
+            return True
+        return False
+
+    # ------------------------------------------------------------- recording
+
+    def begin(self, name: str, cat: str = "app",
+              args: Optional[Dict[str, Any]] = None) -> Optional[Span]:
+        """Open a span (returns None past the cap — ``end`` tolerates it)."""
+        stack = self._stack()
+        with self._lock:
+            if self._full():
+                return None
+            parent = stack[-1].span_id if stack else None
+            sp = Span(next(self._ids), parent, name, cat, self.now(),
+                      self._tid(), args=dict(args or {}))
+            self.spans.append(sp)
+        stack.append(sp)
+        return sp
+
+    def end(self, sp: Optional[Span]) -> None:
+        if sp is None:
+            return
+        stack = self._stack()
+        if stack and stack[-1] is sp:
+            stack.pop()
+        with self._lock:
+            sp.t1 = self.now()
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "app", **args):
+        sp = self.begin(name, cat, args)
+        try:
+            yield sp
+        finally:
+            self.end(sp)
+
+    def add_span(self, name: str, t0: float, t1: float, cat: str = "app",
+                 args: Optional[Dict[str, Any]] = None) -> Optional[Span]:
+        """Record an already-elapsed span with explicit times (e.g. a
+        serve run, whose start and settle are separate pump calls)."""
+        stack = self._stack()
+        with self._lock:
+            if self._full():
+                return None
+            parent = stack[-1].span_id if stack else None
+            sp = Span(next(self._ids), parent, name, cat, float(t0),
+                      self._tid(), t1=float(t1), args=dict(args or {}))
+            self.spans.append(sp)
+            return sp
+
+    def event(self, name: str, **args) -> None:
+        stack = self._stack()
+        with self._lock:
+            if self._full():
+                return
+            parent = stack[-1].span_id if stack else None
+            self.events.append(SpanEvent(next(self._ids), parent, name,
+                                         self.now(), self._tid(),
+                                         dict(args)))
+
+    # --------------------------------------------------------------- queries
+
+    def mark(self) -> Tuple[int, int, int]:
+        """Current (spans, events, ticks) position — pass to
+        ``flight_summary(since=...)`` to summarize just the work after it."""
+        with self._lock:
+            return (len(self.spans), len(self.events), self.timeline.total)
+
+    def emitted_names(self) -> Set[str]:
+        with self._lock:
+            names = {s.name for s in self.spans}
+            names |= {e.name for e in self.events}
+        return names
+
+    def flight_summary(self, since: Optional[Tuple[int, int, int]] = None
+                       ) -> Dict[str, Any]:
+        """Compact flight-recorder digest (embedded in RCA reports): span/
+        event/tick counts and the per-name span histogram.  Deterministic
+        under a VirtualClock — byte-stable inside soak reports."""
+        s0, e0, t0 = since if since is not None else (0, 0, 0)
+        with self._lock:
+            spans = self.spans[s0:]
+            events = self.events[e0:]
+            ticks = self.timeline.total - t0
+            by_name: Dict[str, int] = {}
+            for sp in spans:
+                by_name[sp.name] = by_name.get(sp.name, 0) + 1
+            ts = ([sp.t0 for sp in spans]
+                  + [sp.t1 for sp in spans if sp.t1 is not None]
+                  + [e.ts for e in events])
+            duration = (max(ts) - min(ts)) if ts else 0.0
+        return {
+            "spans": len(spans),
+            "events": len(events),
+            "ticks": int(ticks),
+            "dropped": self.dropped,
+            "duration_s": round(duration, 6),
+            "by_name": {k: by_name[k] for k in sorted(by_name)},
+        }
+
+
+# ---------------------------------------------------------------------------
+# module activation slot (the inject._ARMED pattern)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[Tracer] = None
+
+_NULL = contextlib.nullcontext()
+
+
+def activate(tracer: Tracer) -> Tracer:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a Tracer is already active")
+    _ACTIVE = tracer
+    return tracer
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[Tracer]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def tracing(tracer: Tracer):
+    """``with trace.tracing(tracer): ...`` — activates for the block."""
+    activate(tracer)
+    try:
+        yield tracer
+    finally:
+        deactivate()
+
+
+def span(name: str, cat: str = "app", **args):
+    """Span under the active tracer; a shared no-op context otherwise."""
+    tr = _ACTIVE
+    if tr is None:
+        return _NULL
+    return tr.span(name, cat, **args)
+
+
+def event(name: str, **args) -> None:
+    """Instant event under the active tracer; no-op otherwise."""
+    tr = _ACTIVE
+    if tr is not None:
+        tr.event(name, **args)
+
+
+def coverage_missing(*tracers: Tracer) -> List[str]:
+    """Registry names not emitted by any of the given tracers — the
+    instrumentation-rot self-check (tests drive each layer under a tracer
+    and assert this is empty)."""
+    emitted: Set[str] = set()
+    for tr in tracers:
+        emitted |= tr.emitted_names()
+    return sorted(SITES - emitted)

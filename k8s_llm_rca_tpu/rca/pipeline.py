@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 
 from k8s_llm_rca_tpu.config import RCAConfig, SweepConfig
 from k8s_llm_rca_tpu.graph.executor import CypherSyntaxError
+from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.rca import auditor, cyphergen, locator
 from k8s_llm_rca_tpu.serve.api import AssistantService
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
@@ -201,7 +202,10 @@ class RCAPipeline:
 
     def analyze_incident(self, error_message: str) -> IncidentResult:
         """One incident end-to-end; returns the batch-driver result dict
-        (schema of test_with_file.py:67-204)."""
+        (schema of test_with_file.py:67-204).  With a tracer active
+        (obs/trace.py) the incident runs under an ``rca.incident`` span
+        with per-stage child spans, and the result dict carries a compact
+        ``flight`` summary of everything recorded while it ran."""
         t0 = time.time()
         if self.cfg.fresh_threads:
             self.reset_threads()
@@ -209,37 +213,44 @@ class RCAPipeline:
         if res is not None:
             res.begin_incident()
         result: IncidentResult = {"error_message": error_message}
-        with METRICS.timer("rca.incident"):
+        tracer = obs_trace.active()
+        mark = tracer.mark() if tracer is not None else None
+        with METRICS.timer("rca.incident"), \
+                obs_trace.span("rca.incident", cat="rca",
+                               incident=error_message[:60]):
             # stage 1 runs the degradation ladder under a resilience
             # policy: full engine run (which already retries with
             # feedback) -> ONE reduced-budget attempt -> scripted-oracle
             # plan -> (srcKind only) the Pod default.  Every rung drop is
             # annotated in result["degraded"].
-            if res is None:
-                src_kind = locator.find_srcKind(self.state_executor,
-                                                error_message)
-                plan, attempts = self.plan_destination(error_message,
-                                                       src_kind)
-            else:
-                from k8s_llm_rca_tpu.rca.oracle import scripted_plan
+            with METRICS.timer("rca.stage.locate"), \
+                    obs_trace.span("rca.stage.locate", cat="rca"):
+                if res is None:
+                    src_kind = locator.find_srcKind(self.state_executor,
+                                                    error_message)
+                    plan, attempts = self.plan_destination(error_message,
+                                                           src_kind)
+                else:
+                    from k8s_llm_rca_tpu.rca.oracle import scripted_plan
 
-                src_kind = res.ladder("locate.srcKind", [
-                    ("full", lambda: locator.find_srcKind(
-                        self.state_executor, error_message)),
-                    # the stategraph is down/degraded: Pod is the kind
-                    # every incident fixture's Event hangs off, the least
-                    # wrong starting point a blind planner can pick
-                    ("default-Pod", lambda: "Pod"),
-                ])
-                plan, attempts = res.ladder("locate.plan", [
-                    ("full", lambda: self.plan_destination(error_message,
-                                                           src_kind)),
-                    ("reduced-budget", lambda: self._plan_reduced(
-                        error_message, src_kind)),
-                    ("scripted-oracle", lambda: (scripted_plan(
-                        error_message, src_kind, self.native_kinds,
-                        self.external_kinds), 0)),
-                ])
+                    src_kind = res.ladder("locate.srcKind", [
+                        ("full", lambda: locator.find_srcKind(
+                            self.state_executor, error_message)),
+                        # the stategraph is down/degraded: Pod is the kind
+                        # every incident fixture's Event hangs off, the
+                        # least wrong starting point a blind planner can
+                        # pick
+                        ("default-Pod", lambda: "Pod"),
+                    ])
+                    plan, attempts = res.ladder("locate.plan", [
+                        ("full", lambda: self.plan_destination(
+                            error_message, src_kind)),
+                        ("reduced-budget", lambda: self._plan_reduced(
+                            error_message, src_kind)),
+                        ("scripted-oracle", lambda: (scripted_plan(
+                            error_message, src_kind, self.native_kinds,
+                            self.external_kinds), 0)),
+                    ])
             result["locator_attempts"] = attempts
 
             dest_kind = plan["DestinationKind"]
@@ -253,28 +264,33 @@ class RCAPipeline:
                     self.meta_executor, src_kind, dest_kind, intermediate,
                     self.cfg.metapath_max_hops)
 
-            if res is None:
-                metapaths = _metapaths()
-            else:
-                metapaths = res.ladder("locate.metapath", [
-                    ("full", _metapaths),
-                    ("skipped", lambda: []),
-                ])
+            with METRICS.timer("rca.stage.metapath"), \
+                    obs_trace.span("rca.stage.metapath", cat="rca"):
+                if res is None:
+                    metapaths = _metapaths()
+                else:
+                    metapaths = res.ladder("locate.metapath", [
+                        ("full", _metapaths),
+                        ("skipped", lambda: []),
+                    ])
 
             result["analysis"] = []
             for metapath in metapaths:
                 metapath_str = cyphergen.extend_metapath_construct_string(
                     metapath)
                 analysis: Dict[str, Any] = {"extend_metapath": metapath_str}
-                if res is None:
-                    records = self.compile_and_run(metapath_str,
-                                                   error_message, analysis)
-                else:
-                    records = res.ladder("cypher", [
-                        ("full", lambda: self.compile_and_run(
-                            metapath_str, error_message, analysis)),
-                        ("skipped", lambda: []),
-                    ])
+                with METRICS.timer("rca.stage.cypher"), \
+                        obs_trace.span("rca.stage.cypher", cat="rca",
+                                       metapath=metapath_str[:60]):
+                    if res is None:
+                        records = self.compile_and_run(
+                            metapath_str, error_message, analysis)
+                    else:
+                        records = res.ladder("cypher", [
+                            ("full", lambda: self.compile_and_run(
+                                metapath_str, error_message, analysis)),
+                            ("skipped", lambda: []),
+                        ])
                 if self.reranker is not None and len(records) > 1:
                     top_k = self.cfg.rerank_top_k or None
                     ranked = self.reranker.rerank_records(
@@ -290,14 +306,16 @@ class RCAPipeline:
                             reranker=self.reranker,
                             fields_top_k=self.cfg.rerank_fields_top_k)
 
-                    if res is None:
-                        report, clues = _audit()
-                    else:
-                        report, clues = res.ladder("audit", [
-                            ("full", _audit),
-                            ("skipped", lambda: (
-                                None, {"degraded": "audit skipped"})),
-                        ])
+                    with METRICS.timer("rca.stage.audit"), \
+                            obs_trace.span("rca.stage.audit", cat="rca"):
+                        if res is None:
+                            report, clues = _audit()
+                        else:
+                            report, clues = res.ladder("audit", [
+                                ("full", _audit),
+                                ("skipped", lambda: (
+                                    None, {"degraded": "audit skipped"})),
+                            ])
                     analysis["statepath"].append(
                         {"report": report, "clue": clues})
                 result["analysis"].append(analysis)
@@ -307,6 +325,11 @@ class RCAPipeline:
         t1 = time.time()
         result["time_cost"] = t1 - t0
         result["token_usage"] = self.window_token_usage(int(t0), int(t1) + 1)
+        if tracer is not None:
+            # compact flight-recorder digest of everything recorded while
+            # THIS incident ran (spans/events/ticks since the mark) — the
+            # report-side breadcrumb pointing into the full Chrome trace
+            result["flight"] = tracer.flight_summary(since=mark)
         return result
 
     def window_token_usage(self, tmin: int, tmax: int,

@@ -19,6 +19,7 @@ from typing import Any, Dict, Iterator, Optional
 import jax
 
 from k8s_llm_rca_tpu.config import ModelConfig
+from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 
 log = get_logger(__name__)
@@ -72,10 +73,15 @@ def trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named region in the profiler timeline AND the METRICS timers."""
+    """Named region in the profiler timeline, the METRICS timers, AND the
+    obs span tracer — ONE name shared by XProf captures and flight
+    records, so a region found slow in one shows up under the same name
+    in the other (obs.span is a no-op global check when no tracer is
+    active)."""
     with jax.profiler.TraceAnnotation(name):
         with METRICS.timer(name):
-            yield
+            with obs_trace.span(name, cat="xprof"):
+                yield
 
 
 def device_memory_stats(device: Optional[Any] = None) -> Dict[str, float]:

@@ -40,6 +40,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.serve.backend import GenOptions, LMBackend
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 
@@ -120,6 +121,10 @@ class Run:
     backend_handle: Optional[int] = None
     deadline: Optional[float] = None
     response_message_id: Optional[str] = None
+    # precise (float) start time on the service clock, for the flight
+    # recorder's "serve.run" span (created_at is int seconds for the
+    # reference's window semantics and too coarse for span durations)
+    t_started: Optional[float] = None
 
 
 def render_prompt(assistant: Assistant, thread: Thread,
@@ -215,6 +220,7 @@ class AssistantService:
         run = Run(self._next_id("run"), thread_id, assistant_id,
                   created_at=int(self._clock.time()),
                   instructions_override=instructions)
+        run.t_started = self._clock.time()
         run.deadline = self._clock.time() + self.run_timeout_s
         self.runs[run.id] = run
         self._thread_runs[thread_id].append(run.id)
@@ -227,6 +233,8 @@ class AssistantService:
         run.status = RunStatus.IN_PROGRESS
         self._inflight[run.backend_handle] = run.id
         METRICS.inc("serve.runs_started")
+        obs_trace.event("serve.run_started", run=run.id,
+                        assistant=assistant.name)
         return run
 
     @_locked
@@ -242,7 +250,25 @@ class AssistantService:
             run.status = RunStatus.CANCELLED
             run.completed_at = int(self._clock.time())
             self._inflight.pop(run.backend_handle, None)
+            self._trace_run_settled(run)
         return run
+
+    def _trace_run_settled(self, run: Run) -> None:
+        """Record the run's whole lifetime as one explicit-times
+        'serve.run' span (start = create_run, end = settle — the two are
+        separate pump calls, so the context-manager span API cannot
+        bracket them).  No-op without an active tracer."""
+        tr = obs_trace._ACTIVE
+        if tr is None:
+            return
+        assistant = self.assistants.get(run.assistant_id)
+        now = self._clock.time()
+        t0 = run.t_started if run.t_started is not None else now
+        tr.add_span("serve.run", t0, now, cat="serve",
+                    args={"run": run.id, "status": run.status,
+                          "assistant": assistant.name if assistant else "",
+                          "completion_tokens":
+                          run.usage["completion_tokens"]})
 
     @_locked
     def list_runs(self, thread_id: str, limit: int = 20,
@@ -285,6 +311,21 @@ class AssistantService:
             msgs = msgs[:limit]
         return MessageList(data=msgs)
 
+    # -------------------------------------------------------- observability
+
+    @_locked
+    def prometheus_metrics(self) -> str:
+        """Prometheus text exposition for this service: the global METRICS
+        store (serve/engine/rca counters + phase-latency summaries) plus
+        live engine gauges (running/queued seqs, free/evictable pages,
+        prefix-hit tokens) when the backend carries an engine.  This is
+        the serve API's scrape surface — an HTTP wrapper only needs to
+        return this string with content type text/plain; version=0.0.4."""
+        from k8s_llm_rca_tpu.obs.export import prometheus_text
+
+        return prometheus_text(METRICS,
+                               engine=getattr(self.backend, "engine", None))
+
     # ------------------------------------------------------------ execution
 
     @_locked
@@ -315,11 +356,15 @@ class AssistantService:
                     run.usage["prompt_tokens"] + res.completion_tokens)
                 run.completed_at = int(self._clock.time())
                 del self._inflight[handle]
+                self._trace_run_settled(run)
             elif run.deadline is not None and now > run.deadline:
                 self.backend.cancel(run.backend_handle)
                 run.status = RunStatus.EXPIRED
                 run.completed_at = int(self._clock.time())
                 del self._inflight[handle]
+                self._trace_run_settled(run)
+        if results:
+            obs_trace.event("serve.settled", n=len(results))
 
     def wait_run(self, run_id: str, timeout_s: Optional[float] = None) -> Run:
         # NOT @_locked: the lock is taken per pump iteration, never for the
@@ -358,6 +403,7 @@ class AssistantService:
                     self._inflight.pop(run.backend_handle, None)
                     run.status = RunStatus.EXPIRED
                     run.completed_at = int(self._clock.time())
+                    self._trace_run_settled(run)
                     break
             # with PEER waiters, a REAL sleep (not sleep(0)): lock release
             # does not hand off — this thread would re-acquire before a

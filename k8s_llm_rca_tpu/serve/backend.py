@@ -24,6 +24,7 @@ from typing import Dict, Optional, Protocol, Set, Tuple
 from k8s_llm_rca_tpu.engine.constrain import make_grammar
 from k8s_llm_rca_tpu.engine.engine import InferenceEngine
 from k8s_llm_rca_tpu.faults import inject
+from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
 
@@ -210,6 +211,8 @@ class EngineBackend:
                 text=text,
                 completion_tokens=res.completion_tokens,
                 prompt_tokens=res.prompt_tokens)
+        if results:
+            obs_trace.event("backend.settled", n=len(results))
         return results
 
     def busy(self, handle: int) -> bool:

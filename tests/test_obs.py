@@ -1,0 +1,416 @@
+"""Observability / flight recorder (k8s_llm_rca_tpu/obs/).
+
+Covers the ISSUE-2 acceptance bars:
+
+- deterministic traces: two seeded chaos soaks with a VirtualClock export
+  byte-identical Chrome trace-event JSON, and the document validates
+  (sorted ts, complete X events);
+- the Prometheus renderer escapes HELP text, types counters/summaries/
+  gauges correctly and never duplicates a HELP line; the serve API
+  surfaces the rendering with live engine gauges;
+- the SITES registry self-check: every name the tracer registry declares
+  is emitted by at least one instrumented call site (instrumentation
+  cannot silently rot);
+- Metrics.timings growth is bounded (reservoir) with exact total/count,
+  and reset()/scoped() isolate tests from the global METRICS.
+"""
+
+import jax
+import pytest
+
+from k8s_llm_rca_tpu.config import TINY, EngineConfig
+from k8s_llm_rca_tpu.engine import make_engine
+from k8s_llm_rca_tpu.faults.plan import VirtualClock
+from k8s_llm_rca_tpu.models import llama
+from k8s_llm_rca_tpu.obs import (
+    SITES, Tracer, chrome_trace, chrome_trace_bytes, coverage_missing,
+    prometheus_text, validate_chrome_trace,
+)
+from k8s_llm_rca_tpu.obs import trace as obs_trace
+from k8s_llm_rca_tpu.utils.logging import METRICS, Metrics, TIMING_RESERVOIR
+from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Never leak an active tracer into other tests."""
+    yield
+    if obs_trace.active() is not None:
+        obs_trace.deactivate()
+
+
+@pytest.fixture(scope="module")
+def small_engine():
+    """One TINY paged engine shared by the obs tests (greedy decode:
+    outputs depend only on weights/prompts, same rationale as
+    test_faults.shared_engine)."""
+    cfg = TINY.replace(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    eng = make_engine(
+        cfg, EngineConfig(max_batch=4, max_seq_len=64, paged=True,
+                          page_size=8, num_pages=24,
+                          prefill_buckets=(16, 32), max_new_tokens=8,
+                          temperature=0.0, decode_chunk=1,
+                          prefix_cache=False),
+        params, tok, use_kernel=False)
+    return eng, tok
+
+
+# ---------------------------------------------------------------------------
+# bounded metrics (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedMetrics:
+    def test_reservoir_bounds_growth_keeps_exact_totals(self):
+        m = Metrics()
+        n = TIMING_RESERVOIR + 300
+        for _ in range(n):
+            with m.timer("t"):
+                pass
+        r = m.timings["t"]
+        assert len(r) == TIMING_RESERVOIR          # bounded retention
+        assert r.count == n                        # exact count
+        assert r.total == pytest.approx(sum([r.total]))  # finite
+        snap = m.snapshot()
+        assert snap["t.count"] == float(n)         # snapshot uses EXACT count
+        assert snap["t.total_s"] == pytest.approx(r.total)
+
+    def test_p50_over_retained_window(self):
+        m = Metrics()
+        # bypass the timer to control sample values
+        with m._lock:
+            res = m.timings["t"]
+        for v in range(TIMING_RESERVOIR + 100):
+            res.append(float(v))
+        # the retained window is the NEWEST TIMING_RESERVOIR samples
+        window = res.window()
+        assert len(window) == TIMING_RESERVOIR
+        assert min(window) == 100.0
+        import statistics
+        assert m.p50("t") == statistics.median(window)
+
+    def test_reset_and_scoped_isolation(self):
+        m = Metrics()
+        m.inc("a", 2)
+        with m.timer("t"):
+            pass
+        with m.scoped():
+            assert m.count("a") == 0               # fresh inside
+            m.inc("a", 99)
+            m.inc("only_inside")
+        assert m.count("a") == 2                   # restored
+        assert m.count("only_inside") == 0
+        assert len(m.timings["t"]) == 1
+        m.reset()
+        assert m.count("a") == 0
+        assert m.total("t") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def _record_fixed(tracer: Tracer) -> None:
+    clock = tracer.clock
+    with tracer.span("outer", cat="test", k="v"):
+        clock.sleep(0.5)
+        with tracer.span("inner"):
+            clock.sleep(0.25)
+        tracer.event("blip", x=1)
+    tracer.add_span("detached", t0=0.1, t1=0.3, args={"run": "run_0"})
+
+
+class TestTracer:
+    def test_deterministic_ids_and_parentage(self):
+        t1, t2 = Tracer(clock=VirtualClock()), Tracer(clock=VirtualClock())
+        _record_fixed(t1)
+        _record_fixed(t2)
+        assert [(s.span_id, s.parent_id, s.name, s.t0, s.t1)
+                for s in t1.spans] == \
+               [(s.span_id, s.parent_id, s.name, s.t0, s.t1)
+                for s in t2.spans]
+        outer, inner, detached = t1.spans
+        assert inner.parent_id == outer.span_id
+        assert detached.parent_id is None          # stack empty at add time
+        assert t1.events[0].parent_id == outer.span_id
+        assert outer.t1 - outer.t0 == pytest.approx(0.75)   # virtual time
+
+    def test_bounded_store_counts_drops(self):
+        tr = Tracer(clock=VirtualClock(), max_spans=3)
+        for i in range(6):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.spans) == 3
+        assert tr.dropped == 3
+        doc = chrome_trace(tr)
+        assert doc["metadata"]["dropped"] == 3
+
+    def test_inactive_helpers_are_noops(self):
+        assert obs_trace.active() is None
+        with obs_trace.span("nope"):
+            obs_trace.event("nope.event")
+        # nothing recorded anywhere, nothing raised
+        tr = Tracer()
+        with obs_trace.tracing(tr):
+            assert obs_trace.active() is tr
+            with pytest.raises(RuntimeError, match="already active"):
+                obs_trace.activate(Tracer())
+        assert obs_trace.active() is None
+
+    def test_flight_summary_since_mark(self):
+        tr = Tracer(clock=VirtualClock())
+        with tr.span("before"):
+            pass
+        mark = tr.mark()
+        with tr.span("after"):
+            tr.event("after.event")
+        s = tr.flight_summary(since=mark)
+        assert s["spans"] == 1 and s["events"] == 1
+        assert s["by_name"] == {"after": 1}
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace exporter
+# ---------------------------------------------------------------------------
+
+
+class TestChromeExport:
+    def test_validates_and_is_byte_stable(self):
+        t1, t2 = Tracer(clock=VirtualClock()), Tracer(clock=VirtualClock())
+        _record_fixed(t1)
+        _record_fixed(t2)
+        d1, d2 = chrome_trace(t1), chrome_trace(t2)
+        assert validate_chrome_trace(d1) == len(d1["traceEvents"]) == 4
+        assert chrome_trace_bytes(d1) == chrome_trace_bytes(d2)
+        ts = [e["ts"] for e in d1["traceEvents"]]
+        assert ts == sorted(ts)
+        for ev in d1["traceEvents"]:
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+
+    def test_subtree_export_per_incident(self):
+        tr = Tracer(clock=VirtualClock())
+        with tr.span("rca.incident") as root:
+            with tr.span("rca.stage.locate"):
+                pass
+        with tr.span("other"):
+            pass
+        doc = chrome_trace(tr, root=root.span_id)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert names == {"rca.incident", "rca.stage.locate"}
+        validate_chrome_trace(doc)
+
+    def test_validator_rejects_unsorted_and_unmatched(self):
+        good = {"traceEvents": [
+            {"name": "a", "ph": "X", "ts": 2, "dur": 1, "pid": 1, "tid": 1},
+            {"name": "b", "ph": "i", "ts": 1, "pid": 1, "tid": 1},
+        ]}
+        with pytest.raises(ValueError, match="unsorted"):
+            validate_chrome_trace(good)
+        with pytest.raises(ValueError, match="without matching B"):
+            validate_chrome_trace({"traceEvents": [
+                {"name": "a", "ph": "E", "ts": 1, "pid": 1, "tid": 1}]})
+        with pytest.raises(ValueError, match="unmatched B"):
+            validate_chrome_trace({"traceEvents": [
+                {"name": "a", "ph": "B", "ts": 1, "pid": 1, "tid": 1}]})
+
+
+# ---------------------------------------------------------------------------
+# Prometheus renderer
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    """Engine-shaped stub for gauge rendering (no device work)."""
+
+    def __init__(self):
+        self._active = {0: object(), 1: object()}
+        self._pending = [object()]
+        self._counts = {"engine.prefix_hit_tokens": 7.0}
+        self.allocator = type("A", (), {"n_free": 11})()
+        self.prefix_cache = type("P", (), {"n_evictable": 3})()
+
+
+class TestPrometheus:
+    def test_counter_and_summary_families(self):
+        m = Metrics()
+        m.inc("engine.decode_tokens", 5)
+        with m.timer("rca.incident"):
+            pass
+        text = prometheus_text(m)
+        assert "# TYPE k8s_llm_rca_engine_decode_tokens_total counter" \
+            in text
+        assert "k8s_llm_rca_engine_decode_tokens_total 5" in text
+        assert "# TYPE k8s_llm_rca_rca_incident_seconds summary" in text
+        assert 'k8s_llm_rca_rca_incident_seconds{quantile="0.5"}' in text
+        assert "k8s_llm_rca_rca_incident_seconds_count 1" in text
+
+    def test_help_escaping_and_no_duplicate_help(self):
+        m = Metrics()
+        m.inc("weird\nname\\x", 1)
+        m.inc("weird name x", 1)      # sanitizes to the SAME family
+        text = prometheus_text(m)
+        help_lines = [ln for ln in text.splitlines()
+                      if ln.startswith("# HELP")]
+        assert len(help_lines) == len(set(help_lines))
+        # one family name appears in exactly one HELP line
+        fam = "k8s_llm_rca_weird_name_x_total"
+        assert sum(ln.split()[2] == fam for ln in help_lines) == 1
+        # newline/backslash escaped per the exposition format
+        assert "\\n" in text.split(fam)[1].splitlines()[0] \
+            or any("\\n" in ln or "\\\\" in ln for ln in help_lines)
+        for ln in text.splitlines():
+            assert "\n" not in ln     # trivially true; no raw newlines leak
+
+    def test_engine_gauges(self):
+        text = prometheus_text(Metrics(), engine=_StubEngine())
+        assert "k8s_llm_rca_engine_running_seqs 2" in text
+        assert "k8s_llm_rca_engine_queued_seqs 1" in text
+        assert "k8s_llm_rca_engine_free_pages 11" in text
+        assert "k8s_llm_rca_engine_evictable_pages 3" in text
+        assert "k8s_llm_rca_engine_prefix_hit_tokens 7" in text
+        assert "# TYPE k8s_llm_rca_engine_free_pages gauge" in text
+
+    def test_serve_api_surfaces_rendering(self, small_engine):
+        from k8s_llm_rca_tpu.serve.api import AssistantService
+        from k8s_llm_rca_tpu.serve.backend import EngineBackend
+
+        engine, tok = small_engine
+        service = AssistantService(EngineBackend(engine))
+        text = service.prometheus_metrics()
+        assert "k8s_llm_rca_engine_running_seqs" in text
+        assert "k8s_llm_rca_engine_free_pages" in text
+
+
+# ---------------------------------------------------------------------------
+# golden byte-identity: traced seeded chaos soak (acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestTracedSoak:
+    def test_traced_soak_chrome_json_byte_identical(self):
+        """Two runs of the seeded chaos soak with a VirtualClock-bound
+        tracer must export byte-identical, Perfetto-valid Chrome trace
+        JSON — the flight recorder's golden acceptance bar."""
+        from k8s_llm_rca_tpu.faults.soak import report_bytes, run_chaos_soak
+
+        t1, t2 = Tracer(), Tracer()
+        r1 = run_chaos_soak(seed=0, n_incidents=2, backend="oracle",
+                            tracer=t1)
+        r2 = run_chaos_soak(seed=0, n_incidents=2, backend="oracle",
+                            tracer=t2)
+        d1, d2 = chrome_trace(t1), chrome_trace(t2)
+        assert validate_chrome_trace(d1) > 0
+        assert chrome_trace_bytes(d1) == chrome_trace_bytes(d2)
+        # the traced report (incl. per-incident flight digests) is still
+        # byte-identical, and tracing didn't change the soak outcome
+        assert report_bytes(r1) == report_bytes(r2)
+        assert r1["flight"]["spans"] > 0
+        untr = run_chaos_soak(seed=0, n_incidents=2, backend="oracle")
+        for row, row_t in zip(untr["incidents"], r1["incidents"]):
+            assert row["status"] == row_t["status"]
+            assert "flight" in row_t and row_t["flight"]["spans"] > 0
+
+    def test_engine_tick_timeline_gauges(self, small_engine):
+        """Traced paged-engine run: the tick timeline samples pool
+        gauges, and tracing does not perturb greedy output."""
+        engine, tok = small_engine
+        prompts = [tok.encode("pod oom killed", add_bos=True),
+                   tok.encode("pvc unbound", add_bos=True)]
+        ref = engine.generate(prompts, max_new_tokens=6)
+        tr = Tracer(clock=VirtualClock())
+        with obs_trace.tracing(tr):
+            got = engine.generate(prompts, max_new_tokens=6)
+        assert [r.token_ids for r in ref] == [r.token_ids for r in got]
+        assert tr.timeline.total > 0
+        samples = tr.timeline.samples()
+        last = samples[-1]
+        assert last.free_pages == engine.allocator.n_free
+        assert last.decode_tokens > 0 and last.prefill_tokens > 0
+        assert any(s.running > 0 for s in samples)
+        doc = chrome_trace(tr)
+        validate_chrome_trace(doc)
+        counter_names = {e["name"] for e in doc["traceEvents"]
+                         if e["ph"] == "C"}
+        assert {"engine.seqs", "engine.pages",
+                "engine.tokens", "engine.sched"} <= counter_names
+
+
+# ---------------------------------------------------------------------------
+# site registry self-check (satellite 5): instrumentation cannot rot
+# ---------------------------------------------------------------------------
+
+
+class TestSiteCoverage:
+    def test_every_registered_site_is_emitted(self, small_engine):
+        """Drive each instrumented layer under a tracer and assert the
+        SITES registry is fully covered — a renamed or deleted call site
+        fails HERE, not silently on a dashboard."""
+        from k8s_llm_rca_tpu.faults.policy import (
+            CircuitOpen, ResiliencePolicy, RetriesExhausted, RetryPolicy,
+        )
+        from k8s_llm_rca_tpu.faults.soak import run_chaos_soak
+        from k8s_llm_rca_tpu.serve.api import AssistantService, RunStatus
+        from k8s_llm_rca_tpu.serve.backend import EngineBackend, GenOptions
+
+        engine, tok = small_engine
+        tracers = []
+
+        # (1) serve + backend + engine sites: one run through the
+        # assistants API on the real engine backend
+        tr_engine = Tracer(clock=VirtualClock())
+        tracers.append(tr_engine)
+        with obs_trace.tracing(tr_engine):
+            service = AssistantService(EngineBackend(engine))
+            a = service.create_assistant("inst", "cover", gen=GenOptions(
+                max_new_tokens=4))
+            t = service.create_thread()
+            service.add_message(t.id, "node notready")
+            run = service.create_run(t.id, a.id)
+            assert service.wait_run(run.id).status == RunStatus.COMPLETED
+
+        # (2) rca + graph sites: one clean oracle soak incident
+        tr_soak = Tracer()
+        tracers.append(tr_soak)
+        run_chaos_soak(seed=0, n_incidents=1, backend="oracle",
+                       plan_spec={}, tracer=tr_soak)
+
+        # (3) resilience sites: retry -> breaker open -> probe close ->
+        # ladder rung drop, on a virtual clock
+        clock = VirtualClock()
+        policy = ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                              clock=clock),
+            failure_threshold=1, reset_timeout_s=0.05)
+        tr_pol = Tracer(clock=clock)
+        tracers.append(tr_pol)
+        with obs_trace.tracing(tr_pol):
+            with pytest.raises((RetriesExhausted, CircuitOpen)):
+                policy.call("dep", lambda: (_ for _ in ()).throw(
+                    RuntimeError("boom")))
+            clock.sleep(0.1)
+            assert policy.call("dep", lambda: "ok") == "ok"
+            assert policy.ladder("stage", [
+                ("full", lambda: (_ for _ in ()).throw(RuntimeError("no"))),
+                ("fallback", lambda: 42),
+            ]) == 42
+
+        missing = coverage_missing(*tracers)
+        assert not missing, f"registered sites never emitted: {missing}"
+        # and the registry is the full emitted vocabulary for our names:
+        # anything we emit under a known prefix must be registered
+        prefixes = ("engine.", "serve.", "backend.", "graph.", "rca.",
+                    "resilience.")
+        emitted = set()
+        for tr in tracers:
+            emitted |= tr.emitted_names()
+        unregistered = {n for n in emitted
+                        if n.startswith(prefixes) and n not in SITES}
+        assert not unregistered, \
+            f"emitted sites missing from the registry: {unregistered}"
