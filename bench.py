@@ -369,6 +369,102 @@ def bench_obs(seed: int = 0, n_incidents: int = 2):
             "seed": seed, "n": n_incidents}
 
 
+def bench_rca_resume(n_runs: int = 8, n_appends: int = 256):
+    """Durability-layer costs (serve/journal.py + serve/recover.py),
+    measured end to end in one leg:
+
+    - ``append_ms``: mean wall-clock of one fsync'd journal append over
+      ``n_appends`` run_submit-sized records — the per-mutation overhead
+      a journaled service pays.  Host filesystem I/O: no tunnel, no
+      memoization concerns.
+    - ``recover_wall_s``: wall-clock of ``recover_service`` replaying a
+      crashed sweep's journal and re-queuing every interrupted run
+      (host-side replay + tokenize + engine.submit; no device dispatch
+      inside the timed region).
+    - ``prefix_hit_ratio``: re-prefilled tokens served from the prefix
+      cache while the recovered runs drain, over all prefilled tokens.
+      The crashed runs' prompt pages were published to the cache at their
+      ORIGINAL admission (engine/prefix.py inserts at admission), so the
+      post-restart re-prefill is the designed mostly-HIT path.
+
+    All three are exact measurements of the run; the leg returns counts
+    alongside so the ratio's denominator is auditable."""
+    import os
+    import tempfile
+
+    import jax as _jax
+
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.serve.api import AssistantService
+    from k8s_llm_rca_tpu.serve.backend import EngineBackend, GenOptions
+    from k8s_llm_rca_tpu.serve.journal import RunJournal
+    from k8s_llm_rca_tpu.serve.recover import recover_service
+    from k8s_llm_rca_tpu.utils.logging import METRICS
+
+    with tempfile.TemporaryDirectory() as td:
+        # --- 1. fsync'd append overhead
+        jpath = os.path.join(td, "append.wal")
+        j = RunJournal(jpath)
+        body = "x" * 512                     # run_submit-sized payload
+        t0 = time.perf_counter()
+        for i in range(n_appends):
+            j.append("run_submit", id=f"run_{i:08d}", thread_id="t",
+                     assistant_id="a", created_at=i, instructions=None,
+                     gen=None, prompt=body)
+        append_wall = time.perf_counter() - t0
+        j.close()
+
+        # --- 2. crash + recovery on a prefix-cached TINY engine
+        cfg = TINY.replace(max_seq_len=512)
+        params = llama.init_params(cfg, _jax.random.PRNGKey(0))
+        tok = get_tokenizer(vocab_size=cfg.vocab_size)
+        engine = make_engine(
+            cfg, EngineConfig(max_batch=4, max_seq_len=512, paged=True,
+                              page_size=16, num_pages=128,
+                              prefill_buckets=(128, 256),
+                              max_new_tokens=16, temperature=0.0,
+                              decode_chunk=4, prefix_cache=True),
+            params, tok)
+        wal_path = os.path.join(td, "serve.wal")
+        backend = EngineBackend(engine)
+        service = AssistantService(backend, journal=RunJournal(wal_path))
+        a = service.create_assistant("analyze the incident", "rca")
+        run_ids = []
+        for i in range(n_runs):
+            th = service.create_thread()
+            service.add_message(
+                th.id, f"incident {i}: pod crashloop in namespace ns-{i} "
+                       f"node pressure event repeated restarts")
+            run_ids.append(service.create_run(
+                th.id, a.id, gen=GenOptions(max_new_tokens=16)).id)
+        for _ in range(3):                   # mid-decode, prompts admitted
+            service.retrieve_run(run_ids[0])
+        # the crash: journal handle and engine sequences die
+        service._journal.close()
+        for handle in list(backend._live):
+            backend.cancel(handle)
+
+        hits0 = METRICS.count("engine.prefix_hit_tokens")
+        fills0 = METRICS.count("engine.prefill_tokens")
+        t0 = time.perf_counter()
+        svc, report = recover_service(wal_path, EngineBackend(engine))
+        recover_wall = time.perf_counter() - t0
+        for rid in report["resubmitted"]:
+            svc.wait_run(rid)
+        hits = METRICS.count("engine.prefix_hit_tokens") - hits0
+        fills = METRICS.count("engine.prefill_tokens") - fills0
+        ratio = hits / (hits + fills) if (hits + fills) > 0 else None
+    return {"append_ms": round(append_wall / n_appends * 1e3, 4),
+            "appends": n_appends,
+            "recover_wall_s": round(recover_wall, 4),
+            "records": report["records"],
+            "resubmitted": len(report["resubmitted"]),
+            "prefix_hit_tokens": int(hits),
+            "prefill_tokens": int(fills),
+            "prefix_hit_ratio": round(ratio, 4) if ratio is not None
+            else None}
+
+
 def bench_rca_p50_engine_refthreads(n_incidents: int = 100):
     """The REFERENCE-FAITHFUL thread semantics, measured (VERDICT r4
     weak #4): threads grow across each worker's incidents exactly as the
@@ -460,6 +556,7 @@ def main():
     p50_refthreads = ref_sweep[0] if ref_sweep else None
     chaos = _leg("bench.bench_rca_chaos()", timeout=1500) or {}
     obs = _leg("bench.bench_obs()", timeout=1500) or {}
+    resume = _leg("bench.bench_rca_resume()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -549,6 +646,15 @@ def main():
         "obs_engine_ticks": obs.get("ticks"),
         "obs_trace_bytes": obs.get("trace_bytes"),
         "obs_prom_lines": obs.get("prom_lines"),
+        # durability (serve/journal.py + serve/recover.py): fsync'd
+        # append cost, recovery replay wall-clock, and the re-prefill
+        # prefix-HIT ratio after a crash, each measured in its own
+        # interpreter; null when the leg failed — schema stays stable
+        "rca_resume_journal_append_ms": resume.get("append_ms"),
+        "rca_resume_recover_wall_s": resume.get("recover_wall_s"),
+        "rca_resume_records": resume.get("records"),
+        "rca_resume_resubmitted": resume.get("resubmitted"),
+        "rca_resume_prefix_hit_ratio": resume.get("prefix_hit_ratio"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

@@ -486,6 +486,129 @@ class EngineBase:
                 return True
         return False
 
+    # ------------------------------------------------- snapshot / restore
+
+    def snapshot_sequences(self) -> Dict[str, object]:
+        """Export every live sequence's durable state for crash recovery
+        (serve/recover.py, docs/durability.md).
+
+        Raw KV is deliberately NOT dumped: pages are device memory laid
+        out per-engine, worthless across a restart.  What IS durable —
+        original prompt ids, every generated token (pre-preemption prefix
+        included), remaining budget, stop strings, and the engine RNG key
+        — is exactly what ``restore_sequences`` needs to re-admit the
+        sequence through a normal prefill; with the prefix cache enabled
+        the re-prefill of already-seen tokens is a mostly-HIT path.
+
+        Grammar FSM state is exported as a bool marker only (compiled
+        FSMs are stateful host objects); restore rebuilds it by advancing
+        a freshly compiled FSM over the generated tokens.
+
+        Ordering is the scheduler's own priority: active sequences (in
+        admission order) first, then the pending queue front-to-back —
+        restoring preserves relative progress order deterministically.
+        """
+        resumed = getattr(self, "_resumed", None) or {}
+        seqs = []
+        for st in sorted(self._active.values(), key=lambda s: s.seq_id):
+            gen = list(resumed.get(st.seq_id, [])) + list(st.generated)
+            seqs.append({
+                "seq_id": st.seq_id,
+                "prompt_ids": list(self._prompts.get(st.seq_id, [])),
+                "generated": gen,
+                # an _Active at its budget retires within the same tick,
+                # so between ticks remaining >= 1 always holds; the max()
+                # mirrors _preempt_slot's defensive clamp
+                "remaining_new_tokens": max(
+                    1, st.max_new_tokens - len(st.generated)),
+                "stop_strings": list(st.stop_strings),
+                "grammar": st.grammar is not None,
+            })
+        for req in self._pending:
+            gen = list(resumed.get(req.seq_id, ()))
+            # a preempted request's prompt_ids already carry its generated
+            # prefix; recover the ORIGINAL prompt from _prompts
+            prompt = list(self._prompts.get(req.seq_id, req.prompt_ids))
+            seqs.append({
+                "seq_id": req.seq_id,
+                "prompt_ids": prompt,
+                "generated": gen,
+                "remaining_new_tokens": req.max_new_tokens,
+                "stop_strings": list(req.stop_strings),
+                "grammar": req.grammar is not None,
+            })
+        key = jax.device_get(self._key)
+        return {"rng_key": [int(x) for x in key], "sequences": seqs}
+
+    def restore_sequences(self, snap: Dict[str, object],
+                          grammars: Optional[Dict[int, object]] = None
+                          ) -> List[int]:
+        """Re-admit sequences exported by ``snapshot_sequences`` — into
+        this engine or a fresh same-model one.  Each sequence is queued
+        for a normal prefill of prompt + generated-so-far (the paged
+        preemption/resume path, ``_preempt_slot``), so the engine's
+        greedy-parity guarantees carry over: a restored sequence finishes
+        with exactly the tokens a never-interrupted run produces.
+
+        ``grammars``: freshly compiled FSMs keyed by seq_id for sequences
+        snapshotted with ``grammar: true``; each is advanced over the
+        generated tokens so its state matches the resume point.  Missing
+        a required FSM raises (loud exclusion) rather than silently
+        dropping the constraint.  Returns the restored seq_ids.
+        """
+        resumed = getattr(self, "_resumed", None)
+        if resumed is None:
+            raise ValueError(
+                f"{type(self).__name__} has no resume bookkeeping "
+                f"(_resumed); restore_sequences requires an engine built "
+                f"with preemption/resume support")
+        cap = self.engine_cfg.max_seq_len
+        restored: List[int] = []
+        max_seen = -1
+        for s in snap["sequences"]:
+            seq_id = int(s["seq_id"])
+            if (seq_id in self._prompts
+                    or any(r.seq_id == seq_id for r in self._pending)):
+                raise ValueError(
+                    f"restore collision: seq {seq_id} is already live in "
+                    f"this engine")
+            prompt = [int(t) for t in s["prompt_ids"]]
+            gen = [int(t) for t in s["generated"]]
+            remaining = int(s["remaining_new_tokens"])
+            room = cap - len(prompt) - len(gen) - 1
+            if room < 1:
+                raise ValueError(
+                    f"seq {seq_id} needs {len(prompt) + len(gen) + 2} "
+                    f"cache positions but this engine caps at {cap}; "
+                    f"restore into an engine with max_seq_len >= the "
+                    f"snapshotting engine's")
+            remaining = min(remaining, room)
+            g = (grammars or {}).get(seq_id)
+            if s.get("grammar") and g is None:
+                raise ValueError(
+                    f"seq {seq_id} was grammar-constrained; pass a "
+                    f"freshly compiled FSM via grammars={{{seq_id}: fsm}} "
+                    f"(FSM state is rebuilt by advancing over the "
+                    f"generated tokens, never serialized)")
+            if g is not None:
+                for t in gen:
+                    g.advance(t)
+            self._register(seq_id, prompt)
+            if gen:
+                resumed[seq_id] = list(gen)
+            self._pending.append(_Pending(
+                seq_id, prompt + gen, remaining,
+                tuple(s["stop_strings"]), g))
+            restored.append(seq_id)
+            max_seen = max(max_seen, seq_id)
+        # later submits must not reuse a restored id
+        nxt = next(self._seq_counter)
+        self._seq_counter = itertools.count(max(nxt, max_seen + 1))
+        key = snap.get("rng_key")
+        if key is not None:
+            self._key = jnp.asarray(key, dtype=jnp.uint32)
+        return restored
+
     # -------------------------------------------------- fault injection
 
     FAULT_SITE = inject.SITE_ENGINE_TICK
@@ -508,9 +631,9 @@ class EngineBase:
         engine are ignored with a warning (no pool to exhaust)."""
         if fault.kind in ("stall", "slow"):
             plan.clock.sleep(fault.delay_s or 0.05)
-        elif fault.kind in ("oom", "preempt"):
+        elif fault.kind in ("oom", "preempt", "crash"):
             log.warning("tick fault %r ignored: contiguous engine has no "
-                        "page pool", fault.kind)
+                        "preemption/requeue machinery", fault.kind)
         else:
             log.warning("tick fault %r not applicable to engine ticks",
                         fault.kind)
@@ -864,8 +987,14 @@ class EngineBase:
         return None
 
     def _stop_context(self, st: _Active) -> List[int]:
-        """Tokens eligible for stop-string matching; subclasses prepend any
-        pre-preemption generation so matches can span a resume boundary."""
+        """Tokens eligible for stop-string matching, with any
+        pre-preemption/pre-restore generation prepended so matches can
+        span a resume boundary."""
+        resumed = getattr(self, "_resumed", None)
+        if resumed:
+            prefix = resumed.get(st.seq_id)
+            if prefix:
+                return prefix + st.generated
         return st.generated
 
     def _final_text(self, generated: List[int], reason: str,
@@ -1328,6 +1457,10 @@ class InferenceEngine(EngineBase):
         self._dfa_dev: Dict[int, tuple] = {}   # id(tables) -> device arrays
         self._prompts: Dict[int, List[int]] = {}   # seq_id -> prompt (for
         # n-gram draft lookup; dropped at retirement)
+        # pre-restore generated tokens (restore_sequences): the contiguous
+        # engine never preempts, but a crash-restored sequence still needs
+        # its already-generated prefix stitched back at retirement
+        self._resumed: Dict[int, List[int]] = {}
 
         self._buckets = tuple(
             s for s in sorted(set(engine_cfg.prefill_buckets))
@@ -1519,15 +1652,21 @@ class InferenceEngine(EngineBase):
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)
         self._free_slots.append(slot)
-        self._prompts.pop(st.seq_id, None)
-        text = self._final_text(st.generated, reason, st.stop_strings)
+        # a crash-restored sequence's st.generated holds only post-restore
+        # tokens and its admitted prompt carried the pre-crash generation;
+        # stitch the prefix back and report against the ORIGINAL prompt
+        # (mirrors the paged engine's preemption accounting)
+        orig_prompt = self._prompts.pop(st.seq_id, None)
+        generated = self._resumed.pop(st.seq_id, []) + st.generated
+        text = self._final_text(generated, reason, st.stop_strings)
         return SequenceResult(
             seq_id=st.seq_id,
-            token_ids=list(st.generated),
+            token_ids=list(generated),
             text=text,
             finish_reason=reason,
-            prompt_tokens=st.prompt_tokens,
-            completion_tokens=len(st.generated),
+            prompt_tokens=(len(orig_prompt) if orig_prompt is not None
+                           else st.prompt_tokens),
+            completion_tokens=len(generated),
         )
 
     # ------------------------------------------------- chunked scan tick

@@ -1266,6 +1266,19 @@ class PagedInferenceEngine(EngineBase):
             for _ in range(max(1, fault.wave)):
                 if not self._preempt_youngest():
                     break
+        elif fault.kind == "crash":
+            # process-style teardown between ticks: EVERY active sequence
+            # loses its device KV at once (what a worker kill does) and is
+            # requeued for re-prefill — youngest first, so the requeue-at-
+            # front discipline leaves the OLDEST sequence at the head and
+            # admission order is preserved deterministically
+            n = 0
+            while self._preempt_youngest():
+                n += 1
+            log.warning("tick fault 'crash': dropped device KV of %d "
+                        "active sequence(s); all requeued for re-prefill",
+                        n)
+            self._count("engine.crash_evictions", n)
         elif fault.kind == "oom":
             if self._cp_parts:
                 log.warning("oom tick fault skipped: partitioned CP pool")

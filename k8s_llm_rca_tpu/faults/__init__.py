@@ -5,13 +5,17 @@
   (graph executors, EngineBackend, engine tick loops);
 - ``faults.policy`` — RetryPolicy / CircuitBreaker / degradation ladder;
 - ``faults.soak``   — the chaos soak driver (imported lazily: it pulls in
-  the whole rca pipeline, which itself imports the injection points).
+  the whole rca pipeline, which itself imports the injection points);
+- ``faults.supervisor`` — supervised process-crash/restart harness (the
+  "crash" kind at ``inject.SITE_PROCESS``, recovery via the serve run
+  journal; imported lazily for the same reason as ``soak``).
 """
 
 from k8s_llm_rca_tpu.faults.plan import (  # noqa: F401
     FAULT_KINDS, Fault, FaultPlan, VirtualClock,
 )
 from k8s_llm_rca_tpu.faults.inject import (  # noqa: F401
+    SITE_BACKEND, SITE_ENGINE_TICK, SITE_GRAPH, SITE_PROCESS,
     InjectedFault, InjectedTimeout, arm, armed, disarm,
 )
 from k8s_llm_rca_tpu.faults.policy import (  # noqa: F401

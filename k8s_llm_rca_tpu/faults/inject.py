@@ -18,7 +18,16 @@ Sites in the real stack:
   deadline expires it);
 - ``SITE_ENGINE_TICK`` (``engine/engine.py`` / ``engine/paged.py``
   ``step``): host stall (virtual clock), allocator exhaustion ("oom":
-  the free list is stolen for one tick), forced preemption wave.
+  the free list is stolen for one tick), forced preemption wave, and
+  "crash" (every active sequence loses its device KV between ticks and
+  is requeued for re-prefill — the in-engine half of a worker kill);
+- ``SITE_PROCESS`` (``faults/supervisor.py``): process-level "crash" —
+  the supervisor tears the serving stack down (backend discarded,
+  service dropped) and restarts it from the run journal
+  (serve/recover.py).  Polled from the supervisor's OWN plan at
+  incident boundaries, never from the armed chaos plan, so a crash
+  cannot perturb the armed plan's poll counters (the soak's
+  byte-identity proof depends on that).
 """
 
 from __future__ import annotations
@@ -31,6 +40,7 @@ from k8s_llm_rca_tpu.faults.plan import Fault, FaultPlan
 SITE_GRAPH = "graph.query"
 SITE_BACKEND = "backend.start"
 SITE_ENGINE_TICK = "engine.tick"
+SITE_PROCESS = "serve.process"
 
 # the armed plan; hot paths read this directly (see module docstring)
 _ARMED: Optional[FaultPlan] = None

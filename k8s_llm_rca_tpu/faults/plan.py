@@ -28,9 +28,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 # the vocabulary of injectable behaviors; sites implement the subset that
 # makes sense for them (graph queries: error/timeout/slow/poison/empty;
-# backend runs: error/budget/stall; engine ticks: oom/preempt/stall)
+# backend runs: error/budget/stall; engine ticks: oom/preempt/stall/crash;
+# the serve process boundary: crash — a supervised kill/restart,
+# faults/supervisor.py)
 FAULT_KINDS = ("error", "timeout", "slow", "poison", "empty",
-               "budget", "stall", "oom", "preempt")
+               "budget", "stall", "oom", "preempt", "crash")
 
 
 @dataclass(frozen=True)

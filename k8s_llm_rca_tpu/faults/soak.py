@@ -54,7 +54,7 @@ def default_plan_spec() -> Dict[str, Dict[str, Any]]:
     }
 
 
-def _build_engine_service(run_timeout_s: float, clock):
+def _build_engine_service(run_timeout_s: float, clock, journal=None):
     import jax
 
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
@@ -77,26 +77,31 @@ def _build_engine_service(run_timeout_s: float, clock):
                           paged=True, page_size=64, num_pages=168,
                           prefix_cache=False, decode_chunk=16),
         params, tok, use_kernel=False)
-    return AssistantService(EngineBackend(engine),
-                            run_timeout_s=run_timeout_s,
-                            clock=clock), engine
+    # the factory hands the SAME engine to a restarted backend: it stands
+    # in for the restarted worker's recompiled engine (identical weights,
+    # identical compile) without paying a per-crash recompile
+    factory = lambda: EngineBackend(engine)        # noqa: E731
+    return AssistantService(factory(), run_timeout_s=run_timeout_s,
+                            clock=clock, journal=journal), engine, factory
 
 
-def _build_oracle_service(run_timeout_s: float, clock):
+def _build_oracle_service(run_timeout_s: float, clock, journal=None):
     from k8s_llm_rca_tpu.rca.oracle import OracleBackend
     from k8s_llm_rca_tpu.serve.api import AssistantService
     from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
 
-    return AssistantService(OracleBackend(get_tokenizer()),
-                            run_timeout_s=run_timeout_s,
-                            clock=clock), None
+    factory = lambda: OracleBackend(get_tokenizer())   # noqa: E731
+    return AssistantService(factory(), run_timeout_s=run_timeout_s,
+                            clock=clock, journal=journal), None, factory
 
 
 def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    backend: str = "engine",
                    plan_spec: Optional[Dict[str, Any]] = None,
                    run_timeout_s: float = 1.5,
-                   tracer: Optional[Any] = None) -> Dict[str, Any]:
+                   tracer: Optional[Any] = None,
+                   durable_dir: Optional[str] = None,
+                   supervisor: Optional[Any] = None) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
 
@@ -109,6 +114,19 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     timestamp is virtual and the exported Chrome trace is byte-identical
     run over run (the flight recorder's golden acceptance bar).  The
     report then carries a deterministic ``flight`` summary.
+
+    ``durable_dir``: optional directory for the write-ahead run journal
+    (serve/journal.py) — every service mutation becomes a durable record.
+    The report stays byte-identical with or without it (journaling adds
+    no report fields and touches no virtual clock).
+
+    ``supervisor``: optional faults.supervisor.CrashSupervisor (requires
+    ``durable_dir``) polled at every incident boundary; on a scheduled
+    "crash" fault the serving stack is torn down and rebuilt from the
+    journal mid-sweep — the kill/restart chaos scenario.  The supervisor
+    runs its OWN FaultPlan, so the armed plan's poll counters (and hence
+    the report) match the uninterrupted run exactly; crash/recovery stats
+    live on the supervisor object, not in the report.
     """
     from k8s_llm_rca_tpu.config import RCAConfig
     from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
@@ -126,10 +144,25 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                           clock=clock),
         failure_threshold=4, reset_timeout_s=0.5, reduced_tokens=256)
 
+    journal = None
+    if durable_dir is not None:
+        import os
+
+        from k8s_llm_rca_tpu.serve.journal import RunJournal
+
+        os.makedirs(durable_dir, exist_ok=True)
+        journal = RunJournal(os.path.join(durable_dir, "serve.wal"))
+    if supervisor is not None and journal is None:
+        raise ValueError("supervisor requires durable_dir: the run "
+                         "journal is the only recovery source a crash "
+                         "leaves behind")
+
     if backend == "engine":
-        service, engine = _build_engine_service(run_timeout_s, clock)
+        service, engine, factory = _build_engine_service(
+            run_timeout_s, clock, journal)
     else:
-        service, engine = _build_oracle_service(run_timeout_s, clock)
+        service, engine, factory = _build_oracle_service(
+            run_timeout_s, clock, journal)
     meta = ResilientExecutor(InMemoryGraphExecutor(build_metagraph()),
                              policy, dep="graph.meta")
     state = ResilientExecutor(InMemoryGraphExecutor(build_stategraph()),
@@ -164,6 +197,12 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                 row["error"] = f"{type(e).__name__}: {e}"
                 n_failed += 1
                 incidents.append(row)
+                if supervisor is not None:
+                    # keep supervisor polls at exactly one per incident
+                    # (both outcome paths), so its schedule is a pure
+                    # function of (plan, n_incidents)
+                    service = supervisor.checkpoint(
+                        pipeline, service, factory, run_timeout_s, clock)
                 continue
             degraded = result.get("degraded", [])
             row["status"] = "degraded" if degraded else "resolved"
@@ -181,6 +220,19 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
             else:
                 n_resolved += 1
             incidents.append(row)
+            if supervisor is not None:
+                # incident boundary: the supervisor's own plan decides
+                # whether the "process" dies here; on crash the recovered
+                # service replaces ours (pipeline rebound inside)
+                service = supervisor.checkpoint(
+                    pipeline, service, factory, run_timeout_s, clock)
+
+    if journal is not None:
+        # close the CURRENT journal (a supervised crash may have swapped
+        # in a reopened one on the same path)
+        live_journal = getattr(service, "_journal", None)
+        if live_journal is not None:
+            live_journal.close()
 
     report = {
         "seed": seed,

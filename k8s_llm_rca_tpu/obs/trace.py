@@ -62,6 +62,9 @@ SITES = frozenset({
     "serve.run",
     "serve.settled",
     "backend.settled",
+    # durability layer (serve/journal.py, serve/recover.py)
+    "serve.journal.append",
+    "serve.recover.replay",
     # graph layer
     "graph.query",
     # rca pipeline stages

@@ -12,3 +12,10 @@ from k8s_llm_rca_tpu.serve.backend import (  # noqa: F401
     LMBackend,
     EchoBackend,
 )
+from k8s_llm_rca_tpu.serve.journal import (  # noqa: F401
+    RunJournal,
+    read_journal,
+)
+from k8s_llm_rca_tpu.serve.recover import (  # noqa: F401
+    recover_service,
+)

@@ -158,14 +158,19 @@ class AssistantService:
     """The 'server': owns assistants/threads/runs and drives an LMBackend."""
 
     def __init__(self, backend: LMBackend, run_timeout_s: float = 600.0,
-                 clock=None):
+                 clock=None, journal=None):
         # ``clock``: injectable time source (time()/sleep()) for run
         # timestamps and deadlines — the real ``time`` module by default,
         # a faults.plan.VirtualClock under chaos runs so deadline expiry
         # happens after a deterministic number of pumps, not wall seconds
+        # ``journal``: optional serve.journal.RunJournal.  Every mutation
+        # hook below is guarded by a single ``is None`` check (same
+        # discipline as faults/inject.py) — the default path does zero
+        # journal work, builds zero records, touches zero files.
         self.backend = backend
         self.run_timeout_s = run_timeout_s
         self._clock = clock if clock is not None else time
+        self._journal = journal
         self.assistants: Dict[str, Assistant] = {}
         self.threads: Dict[str, Thread] = {}
         self.runs: Dict[str, Run] = {}
@@ -188,6 +193,11 @@ class AssistantService:
         a = Assistant(self._next_id("asst"), name, instructions, model,
                       gen or GenOptions())
         self.assistants[a.id] = a
+        if self._journal is not None:
+            from k8s_llm_rca_tpu.serve.journal import encode_gen
+            self._journal.append("create_assistant", id=a.id, name=a.name,
+                                 instructions=a.instructions, model=a.model,
+                                 gen=encode_gen(a.gen))
         return a
 
     @_locked
@@ -199,6 +209,8 @@ class AssistantService:
         t = Thread(self._next_id("thread"))
         self.threads[t.id] = t
         self._thread_runs[t.id] = []
+        if self._journal is not None:
+            self._journal.append("create_thread", id=t.id)
         return t
 
     @_locked
@@ -210,6 +222,10 @@ class AssistantService:
                     role: str = "user") -> Message:
         m = Message(self._next_id("msg"), role, content, time.time())
         self.threads[thread_id].messages.append(m)
+        if self._journal is not None:
+            self._journal.append("add_message", thread_id=thread_id,
+                                 id=m.id, role=m.role, content=m.raw_content,
+                                 created_at=m.created_at)
         return m
 
     @_locked
@@ -232,6 +248,16 @@ class AssistantService:
         run.backend_handle = self.backend.start(prompt, opts)
         run.status = RunStatus.IN_PROGRESS
         self._inflight[run.backend_handle] = run.id
+        if self._journal is not None:
+            # journaled AFTER backend.start: a submission the backend
+            # rejected (BudgetError) never reaches the journal, so replay
+            # cannot resurrect a run that was never accepted
+            from k8s_llm_rca_tpu.serve.journal import encode_gen
+            self._journal.append(
+                "run_submit", id=run.id, thread_id=thread_id,
+                assistant_id=assistant_id, created_at=run.created_at,
+                instructions=instructions, gen=encode_gen(gen),
+                prompt=prompt)
         METRICS.inc("serve.runs_started")
         obs_trace.event("serve.run_started", run=run.id,
                         assistant=assistant.name)
@@ -250,8 +276,26 @@ class AssistantService:
             run.status = RunStatus.CANCELLED
             run.completed_at = int(self._clock.time())
             self._inflight.pop(run.backend_handle, None)
+            if self._journal is not None:
+                self._journal_settle(run)
             self._trace_run_settled(run)
         return run
+
+    def _journal_settle(self, run: Run) -> None:
+        """Append the run's terminal transition.  Only ever called behind
+        ``self._journal is not None`` — never on the default path."""
+        response = None
+        if run.response_message_id is not None:
+            for m in self.threads[run.thread_id].messages:
+                if m.id == run.response_message_id:
+                    response = {"id": m.id, "role": m.role,
+                                "content": m.raw_content,
+                                "created_at": m.created_at}
+                    break
+        self._journal.append(
+            "run_settle", id=run.id, status=run.status,
+            completed_at=run.completed_at, usage=dict(run.usage),
+            error=run.error, response=response)
 
     def _trace_run_settled(self, run: Run) -> None:
         """Record the run's whole lifetime as one explicit-times
@@ -356,12 +400,16 @@ class AssistantService:
                     run.usage["prompt_tokens"] + res.completion_tokens)
                 run.completed_at = int(self._clock.time())
                 del self._inflight[handle]
+                if self._journal is not None:
+                    self._journal_settle(run)
                 self._trace_run_settled(run)
             elif run.deadline is not None and now > run.deadline:
                 self.backend.cancel(run.backend_handle)
                 run.status = RunStatus.EXPIRED
                 run.completed_at = int(self._clock.time())
                 del self._inflight[handle]
+                if self._journal is not None:
+                    self._journal_settle(run)
                 self._trace_run_settled(run)
         if results:
             obs_trace.event("serve.settled", n=len(results))
@@ -393,6 +441,8 @@ class AssistantService:
                     # backend lost the handle without a result
                     run.status = RunStatus.FAILED
                     run.error = "backend dropped the run"
+                    if self._journal is not None:
+                        self._journal_settle(run)
                     break
                 if timeout_s is not None and self._clock.time() - t0 > timeout_s:
                     # mirror _pump's deadline path: cancel the backend run
@@ -403,6 +453,8 @@ class AssistantService:
                     self._inflight.pop(run.backend_handle, None)
                     run.status = RunStatus.EXPIRED
                     run.completed_at = int(self._clock.time())
+                    if self._journal is not None:
+                        self._journal_settle(run)
                     self._trace_run_settled(run)
                     break
             # with PEER waiters, a REAL sleep (not sleep(0)): lock release

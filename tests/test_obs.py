@@ -348,7 +348,7 @@ class TestTracedSoak:
 
 
 class TestSiteCoverage:
-    def test_every_registered_site_is_emitted(self, small_engine):
+    def test_every_registered_site_is_emitted(self, small_engine, tmp_path):
         """Drive each instrumented layer under a tracer and assert the
         SITES registry is fully covered — a renamed or deleted call site
         fails HERE, not silently on a dashboard."""
@@ -362,18 +362,27 @@ class TestSiteCoverage:
         engine, tok = small_engine
         tracers = []
 
-        # (1) serve + backend + engine sites: one run through the
-        # assistants API on the real engine backend
+        # (1) serve + backend + engine + durability sites: one journaled
+        # run through the assistants API on the real engine backend, then
+        # a journal replay (serve/recover.py)
+        from k8s_llm_rca_tpu.serve.journal import RunJournal
+        from k8s_llm_rca_tpu.serve.recover import recover_service
+
+        wal = str(tmp_path / "serve.wal")
         tr_engine = Tracer(clock=VirtualClock())
         tracers.append(tr_engine)
         with obs_trace.tracing(tr_engine):
-            service = AssistantService(EngineBackend(engine))
+            service = AssistantService(EngineBackend(engine),
+                                       journal=RunJournal(wal))
             a = service.create_assistant("inst", "cover", gen=GenOptions(
                 max_new_tokens=4))
             t = service.create_thread()
             service.add_message(t.id, "node notready")
             run = service.create_run(t.id, a.id)
             assert service.wait_run(run.id).status == RunStatus.COMPLETED
+            service._journal.close()
+            recovered, _ = recover_service(wal, EngineBackend(engine))
+            assert recovered.runs[run.id].status == RunStatus.COMPLETED
 
         # (2) rca + graph sites: one clean oracle soak incident
         tr_soak = Tracer()

@@ -112,8 +112,12 @@ class TestTraceAndMemory:
             assert isinstance(v, float)
 
     def test_trace_capture_writes_files(self, tmp_path):
+        import jax
         import jax.numpy as jnp
 
+        if not hasattr(jax.profiler, "ProfileOptions"):
+            pytest.skip("jax.profiler.ProfileOptions unavailable on this "
+                        "jax (capability gate, not a regression)")
         d = str(tmp_path / "trace")
         with profiling.trace(d):
             with profiling.annotate("test.region"):
