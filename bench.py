@@ -242,7 +242,13 @@ def bench_rca_p50_engine(n_incidents: int = 100, workers: int = 16,
                           # sequential tick count: slots x decode_chunk
                           # steps per dispatch maximizes tokens per tick,
                           # and the DFA stages ride the same scan
-                          decode_chunk=decode_chunk),
+                          decode_chunk=decode_chunk,
+                          # overlapped hot loop is the serving default
+                          # (docs/performance.md): admission first-token
+                          # fetches coalesce and tick state stays device-
+                          # resident, cutting blocking host syncs on this
+                          # dispatch-bound host
+                          host_overlap=True),
         params, tok)
     service = AssistantService(EngineBackend(engine))
     work: "queue.Queue[str]" = queue.Queue()
@@ -465,6 +471,73 @@ def bench_rca_resume(n_runs: int = 8, n_appends: int = 256):
             else None}
 
 
+def bench_host_overlap(n_prompts: int = 48, max_batch: int = 8,
+                       prompt_len: int = 64, max_new: int = 32):
+    """Overlapped-hot-loop leg (docs/performance.md): the TINY paged
+    engine driven stepwise (decode_chunk=1 — the mode whose per-tick
+    blocking fetch the overlap targets) with ``host_overlap`` off, then
+    on, over identical prompt sets.
+
+    The published comparisons are COUNTER RATIOS — d2h sync points and
+    h2d full-array uploads per committed decode token, from the engine's
+    own ``engine.d2h_syncs``/``engine.h2d_uploads``/``engine.decode_tokens``
+    counters — which are exact event counts, immune to the tunnel's
+    identical-execution memoization and its ~0.25 s dispatch latency.
+    ``tokens_per_s``/``occupancy`` for the overlap run follow the sweep
+    leg's methodology (committed tokens over host wall-clock across
+    hundreds of data-dependent ticks) and obey measurement-or-null."""
+    from k8s_llm_rca_tpu.engine import make_engine
+
+    cfg = TINY.replace(max_seq_len=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    rng = np.random.default_rng(11)
+    prompt_sets = [
+        [list(rng.integers(1, cfg.vocab_size - 1, prompt_len).astype(int))
+         for _ in range(n_prompts)] for _ in range(2)]
+
+    def run(overlap: bool):
+        ecfg = EngineConfig(max_batch=max_batch, max_seq_len=256,
+                            paged=True, page_size=16, num_pages=160,
+                            prefill_buckets=(prompt_len,),
+                            max_new_tokens=max_new, temperature=0.0,
+                            decode_chunk=1, prefix_cache=False,
+                            host_overlap=overlap)
+        engine = make_engine(cfg, ecfg, params, tok)
+        # compile pass (also warms the overlap jit), then the measured
+        # pass with different prompts so no dispatch repeats
+        engine.generate(prompt_sets[0][:max_batch], max_new_tokens=max_new)
+        c0 = dict(engine._counts)
+        ticks0 = _metrics_ticks()
+        t0 = time.perf_counter()
+        engine.generate(prompt_sets[1], max_new_tokens=max_new)
+        wall = time.perf_counter() - t0
+        ticks = _metrics_ticks() - ticks0
+        d = {k: engine._counts.get(k, 0.0) - c0.get(k, 0.0)
+             for k in ("engine.decode_tokens", "engine.d2h_syncs",
+                       "engine.h2d_uploads", "engine.dispatches")}
+        return d, wall, ticks
+
+    plain, _, _ = run(False)
+    over, wall, ticks = run(True)
+    tokens = over["engine.decode_tokens"]
+    tps = tokens / wall if wall > 0 else None
+    occ = tokens / (ticks * max_batch) if ticks else None
+
+    def per_tok(c):
+        n = c["engine.decode_tokens"]
+        return round(c["engine.d2h_syncs"] / n, 4) if n else None
+
+    return {"tokens_per_s": round(tps, 2) if tps else None,
+            "occupancy": round(occ, 4) if occ is not None else None,
+            "d2h_syncs_per_token": per_tok(over),
+            "plain_d2h_syncs_per_token": per_tok(plain),
+            "h2d_uploads": int(over["engine.h2d_uploads"]),
+            "plain_h2d_uploads": int(plain["engine.h2d_uploads"]),
+            "decode_tokens": int(tokens), "wall_s": round(wall, 2),
+            "batch": max_batch}
+
+
 def bench_rca_p50_engine_refthreads(n_incidents: int = 100):
     """The REFERENCE-FAITHFUL thread semantics, measured (VERDICT r4
     weak #4): threads grow across each worker's incidents exactly as the
@@ -554,6 +627,7 @@ def main():
     ref_sweep = _leg("bench.bench_rca_p50_engine_refthreads()",
                      timeout=1800)
     p50_refthreads = ref_sweep[0] if ref_sweep else None
+    hover = _leg("bench.bench_host_overlap()", timeout=1500) or {}
     chaos = _leg("bench.bench_rca_chaos()", timeout=1500) or {}
     obs = _leg("bench.bench_obs()", timeout=1500) or {}
     resume = _leg("bench.bench_rca_resume()", timeout=1500) or {}
@@ -617,7 +691,11 @@ def main():
         **f_1b,
         # TINY RCA engine sweep: measured tok/s gated like every leg
         "engine_measured_tokens_per_s": eng_tps if sweep_ok else None,
-        "engine_measured_mfu": eng_mfu,
+        # the sweep's MFU cross-check is computed from an ASSUMED mean
+        # context (1024 tokens), so it is a sanity MODEL, not a
+        # measurement — it feeds the credibility gate above but a named
+        # field must not publish it (measurement-or-null policy)
+        "engine_measured_mfu": None,
         "engine_decode_tokens": eng_tokens,
         "engine_sweep_wall_s": eng_wall,
         "engine_sweep_occupancy": eng_occ,
@@ -631,6 +709,17 @@ def main():
         if p50_refthreads is not None else None,
         "rca_engine_incidents": n_engine,
         "rca_engine_workers": n_workers,
+        # overlapped hot loop (docs/performance.md): counter-ratio
+        # comparison (exact, memoization-immune) plus measured tok/s of
+        # the overlap run; null when the leg failed — schema stays stable
+        "host_overlap_tokens_per_s": hover.get("tokens_per_s"),
+        "host_overlap_sweep_occupancy": hover.get("occupancy"),
+        "host_overlap_d2h_syncs_per_token":
+        hover.get("d2h_syncs_per_token"),
+        "host_overlap_plain_d2h_syncs_per_token":
+        hover.get("plain_d2h_syncs_per_token"),
+        "host_overlap_h2d_uploads": hover.get("h2d_uploads"),
+        "host_overlap_plain_h2d_uploads": hover.get("plain_h2d_uploads"),
         # seeded chaos soak (faults/): exact run counts or null if the
         # leg failed — the schema stays stable round over round
         "rca_chaos_completed_incidents": chaos.get("completed"),

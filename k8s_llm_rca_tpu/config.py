@@ -212,6 +212,15 @@ class EngineConfig:
     # mask engine) when a toolchain can build them; pure-Python fallback
     # is behavior-identical
     native: bool = True
+    # overlapped serving hot loop (docs/performance.md): device-resident
+    # decode state (cur_tokens/lengths/block_tables stay on device between
+    # ticks), coalesced device->host syncs (one packed fetch per flush),
+    # deferred admission first-token fetches, and — for decode_chunk == 1
+    # engines without speculation or live grammars — a one-tick-lagged
+    # commit so host bookkeeping overlaps the in-flight device step.
+    # Greedy byte-parity with host_overlap=False is guaranteed for every
+    # supported composition; cp_mesh is excluded (loud ValueError).
+    host_overlap: bool = False
 
 
 @dataclass(frozen=True)

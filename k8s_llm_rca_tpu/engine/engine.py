@@ -408,6 +408,20 @@ class EngineBase:
     _pp_m: Optional[int] = None
     # draft-model speculation (speculative.ModelDraft); None = n-gram drafts
     _draft = None
+    # overlapped hot loop (engine_cfg.host_overlap; docs/performance.md).
+    # _inflight: dispatched-but-uncommitted fast-path ticks, oldest first;
+    # each entry is {"slots": [(slot, seq_id)...], "toks": device [B],
+    # "admits": deferred first-token records}.  _admit_pending: sequences
+    # activated this tick whose sampled first token has not crossed to
+    # host yet.  _flushed_out: results produced by an out-of-tick flush
+    # (cancel/snapshot/fault barrier), surfaced by the next _tick so
+    # step() callers never lose them.  All three are lazily re-bound to
+    # real lists by the subclass constructors.
+    _overlap: bool = False
+    _overlap_lag: int = 2
+    _inflight: Optional[List[dict]] = None
+    _admit_pending: Optional[list] = None
+    _flushed_out: Optional[list] = None
 
     # -------------------------------------------------------- shared api
 
@@ -472,6 +486,7 @@ class EngineBase:
         cannot leak allocator blocks).  No result is produced — callers
         that already dropped the handle simply never see one.  Returns
         whether the sequence was still live."""
+        self._overlap_barrier()   # commit in-flight tokens before retiring
         for i, req in enumerate(self._pending):
             if req.seq_id == seq_id:
                 del self._pending[i]
@@ -508,6 +523,10 @@ class EngineBase:
         admission order) first, then the pending queue front-to-back —
         restoring preserves relative progress order deterministically.
         """
+        # the overlapped hot loop may hold 1-2 dispatched-but-uncommitted
+        # tokens per slot; commit them first so st.generated is complete
+        # (the single invalidation point durability rides through)
+        self._overlap_barrier()
         resumed = getattr(self, "_resumed", None) or {}
         seqs = []
         for st in sorted(self._active.values(), key=lambda s: s.seq_id):
@@ -556,6 +575,7 @@ class EngineBase:
         a required FSM raises (loud exclusion) rather than silently
         dropping the constraint.  Returns the restored seq_ids.
         """
+        self._overlap_barrier()
         resumed = getattr(self, "_resumed", None)
         if resumed is None:
             raise ValueError(
@@ -622,6 +642,9 @@ class EngineBase:
             return
         fault = plan.poll(self.FAULT_SITE)
         if fault is not None:
+            # fault kinds that preempt/crash slots must see committed
+            # host state, not a 1-2 token stale mirror
+            self._overlap_barrier()
             self._apply_tick_fault(fault, plan)
 
     def _apply_tick_fault(self, fault, plan) -> None:
@@ -654,7 +677,7 @@ class EngineBase:
             self._key, sub = jax.random.split(self._key)
             masked = self._sample_masked(
                 logits, sub, self.sampling, jnp.asarray(c.allow[None]))
-            return int(host_np(masked)[0])
+            return int(self._fetch(masked)[0][0])
         return sampled
 
     def _budget_remaining(self, st: _Active) -> int:
@@ -702,6 +725,167 @@ class EngineBase:
             c = self._counts = {}
         c[name] = c.get(name, 0.0) + value
 
+    # ---------------------------------------- overlapped hot loop (shared)
+    #
+    # docs/performance.md is the design note.  Invariants enforced here:
+    #  - host commit order per sequence is exactly the plain engine's
+    #    (admission first token, then decode tokens in dispatch order);
+    #  - _inflight entries only exist across fast-path ticks; every other
+    #    path (grammar, speculation, chunked scan, cancel, snapshot,
+    #    restore, faults) flushes FIRST, so it observes committed state;
+    #  - a slot retired/preempted while its tokens were in flight simply
+    #    drops them at flush (the seq_id guard below) — greedy re-prefill
+    #    regenerates identical tokens, so parity is preserved.
+
+    def _fetch(self, *arrays) -> Tuple[np.ndarray, ...]:
+        """ONE coalesced device->host sync: start async copies for every
+        device array, then materialize all of them.  Counted as a single
+        ``engine.d2h_syncs`` when any input actually lives on device —
+        the counter measures sync POINTS (each costs one ~0.25 s tunnel
+        round-trip regardless of payload count), not arrays moved."""
+        if any(not isinstance(a, np.ndarray) for a in arrays):
+            self._count("engine.d2h_syncs")
+        for a in arrays:
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        return tuple(host_np(a) for a in arrays)
+
+    def _overlap_fast(self) -> bool:
+        """Whether THIS tick may dispatch without waiting to commit (the
+        one-tick-lagged fast path).  Chunked-scan engines amortize host
+        work in-scan already; speculation and live/queued grammar slots
+        need host tokens (drafts, FSM masks) before the next dispatch, so
+        they take the flush-first synchronous path — per the tentpole
+        contract, grammar forces sync per-batch composition, never by
+        disabling overlap globally."""
+        if not self._overlap:
+            return False
+        cfg = self.engine_cfg
+        if cfg.decode_chunk > 1 or cfg.speculative_k > 0:
+            return False
+        if any(st.grammar is not None for st in self._active.values()):
+            return False
+        if any(r.grammar is not None for r in self._pending):
+            return False
+        return True
+
+    def _defer_first(self, st: _Active, first_dev, idx: int) -> None:
+        """Queue an admitted sequence's on-device first token; the host
+        value lands at the next drain/flush (one coalesced fetch for ALL
+        admissions instead of one blocking fetch per admission group)."""
+        self._admit_pending.append((st, first_dev, idx))
+
+    def _take_admit_pending(self) -> list:
+        pend, self._admit_pending = self._admit_pending, []
+        return pend
+
+    def _note_first_token(self, slot: int, token: int,
+                          update_dev: bool) -> None:
+        """Subclass hook: reflect an admission's first committed token
+        into the engine's token state.  ``update_dev`` is False when the
+        commit happens at a lagged flush — the device array has already
+        advanced past the first token, so only host mirrors may move."""
+
+    def _commit_first(self, st: _Active, token: int,
+                      update_dev: bool = True) -> Optional[SequenceResult]:
+        """Host-side commit of an admission's first token (the deferred
+        half of _activate).  ``update_dev=False`` at a lagged flush: the
+        device token array has advanced past the first token, so only
+        host mirrors may move.  The liveness guard drops the token when
+        the slot was preempted before the fetch landed: the requeued
+        prompt then re-prefills and greedily re-samples the SAME token,
+        so nothing is lost (docs/performance.md)."""
+        live = self._active.get(st.slot) is st
+        if not live:
+            return None
+        st.generated.append(token)
+        self._note_first_token(st.slot, token, update_dev=update_dev)
+        reason = self._finish_reason(st, token, st.prompt_tokens)
+        if reason is not None:
+            return self._retire(st.slot, reason)
+        return None
+
+    def _drain_admission_commits(self) -> List[SequenceResult]:
+        """Fetch every deferred admission first token in ONE sync and
+        commit them in admission order."""
+        pend = self._take_admit_pending()
+        if not pend:
+            return []
+        uniq: Dict[int, int] = {}
+        order = []
+        for _, a, _ in pend:
+            if id(a) not in uniq:
+                uniq[id(a)] = len(order)
+                order.append(a)
+        hosts = self._fetch(*order)
+        out: List[SequenceResult] = []
+        for st, a, i in pend:
+            r = self._commit_first(st, int(hosts[uniq[id(a)]][i]))
+            if r is not None:
+                out.append(r)
+        return out
+
+    def _note_flush_entry(self, entry: dict) -> None:
+        """Subclass hook, called once per flushed entry BEFORE its commits
+        (the paged engine decrements its per-slot in-flight counters)."""
+
+    def _overlap_post_commit(self, slot: int, token: int) -> None:
+        """Subclass hook: per-token host-mirror update during a lagged
+        flush commit (the paged engine advances lengths/cur_tokens)."""
+
+    def _overlap_flush(self) -> List[SequenceResult]:
+        """Commit every in-flight fast-path tick: one coalesced fetch for
+        all entries' token vectors + deferred admission firsts, then the
+        plain commit loop per entry in dispatch order.  Safe to call any
+        time; a no-op when nothing is in flight."""
+        entries, self._inflight = self._inflight, []
+        finished: List[SequenceResult] = []
+        if entries:
+            uniq: Dict[int, int] = {}
+            order = []
+            for e in entries:
+                for a in [e["toks"]] + [rec[1] for rec in e["admits"]]:
+                    if id(a) not in uniq:
+                        uniq[id(a)] = len(order)
+                        order.append(a)
+            hosts = self._fetch(*order)
+            for e in entries:
+                self._note_flush_entry(e)
+                for st, a, i in e["admits"]:
+                    r = self._commit_first(st, int(hosts[uniq[id(a)]][i]),
+                                           update_dev=False)
+                    if r is not None:
+                        finished.append(r)
+                toks_host = hosts[uniq[id(e["toks"])]]
+                # only slots still owned by the sequence that was active
+                # at dispatch time commit; retired/preempted slots' tokens
+                # are dropped (see class invariants above)
+                slots = [s for s, sid in e["slots"]
+                         if s in self._active
+                         and self._active[s].seq_id == sid]
+                finished.extend(self._commit_scanned(
+                    slots, toks_host[None, :], 1,
+                    self._overlap_post_commit))
+        finished.extend(self._drain_admission_commits())
+        return finished
+
+    def _overlap_barrier(self) -> None:
+        """Flush outside a tick (cancel/snapshot/restore/fault).  Results
+        finished by the flush are stashed and surfaced by the NEXT tick,
+        so step() callers never lose them."""
+        if self._inflight or self._admit_pending:
+            out = self._overlap_flush()
+            if out:
+                self._flushed_out.extend(out)
+            self._invalidate_device_state()
+
+    def _invalidate_device_state(self) -> None:
+        """Subclass hook — the single invalidation point: host mirrors
+        changed behind the device-resident cache, re-upload before the
+        next dispatch.  No-op for engines whose token state IS the device
+        array (contiguous) and for the plain path."""
+
     def step(self) -> List[SequenceResult]:
         """One engine tick (the public pump surface): apply this tick's
         scheduled fault, run the subclass tick body (``_tick``), and —
@@ -745,7 +929,10 @@ class EngineBase:
             prefix_hit_tokens=c.get("engine.prefix_hit_tokens", 0.0),
             preemptions=c.get("engine.preemptions", 0.0),
             admission_rejections=c.get("engine.admission_rejections",
-                                       0.0)))
+                                       0.0),
+            h2d_uploads=c.get("engine.h2d_uploads", 0.0),
+            d2h_syncs=c.get("engine.d2h_syncs", 0.0),
+            dispatches=c.get("engine.dispatches", 0.0)))
 
     # ---------------------------------------- chunked scan tick (shared)
 
@@ -1022,7 +1209,9 @@ class EngineBase:
         k = self.engine_cfg.speculative_k
         if k <= 0 or self.engine_cfg.temperature != 0.0:
             return False
-        lengths_host = host_np(self.lengths)      # ONE device sync per tick
+        # ONE device sync per tick (free on the paged engine: its lengths
+        # mirror is host numpy, which _fetch passes through uncounted)
+        (lengths_host,) = self._fetch(self.lengths)
         return all(self._spec_room_ok(s, k + 1, lengths_host)
                    for s in self._active)
 
@@ -1160,10 +1349,11 @@ class EngineBase:
         host path (ship logits, _greedy_with_grammar per position).
         Returns (greedy_host [B, T], logits_host or None, constrained)."""
         if not self._need_spec_logits(active_slots):
-            return host_np(greedy), None, False
+            return self._fetch(greedy)[0], None, False
         tables = self._uniform_dfa_tables()
         if tables is None:
-            return host_np(greedy), host_np(logits), False
+            greedy_host, logits_host = self._fetch(greedy, logits)
+            return greedy_host, logits_host, False
         (allow_t, next_t, dist_t, close_t, complete_t,
          _) = self._dfa_device_tables(tables)
         states, remaining = self._dfa_scan_vectors(tables)
@@ -1171,7 +1361,7 @@ class EngineBase:
             logits, jnp.asarray(states), jnp.asarray(remaining),
             self.tokenizer.eos_id, allow_t, next_t, dist_t, close_t,
             complete_t)
-        return host_np(greedy), None, True
+        return self._fetch(greedy)[0], None, True
 
 
 class InferenceEngine(EngineBase):
@@ -1230,6 +1420,13 @@ class InferenceEngine(EngineBase):
                              "(CP already seq-shards activations), and is "
                              "unsupported on the PP paths (the pipelined "
                              "prefill/decode do not thread sp_mesh)")
+        if engine_cfg.host_overlap and cp_mesh is not None:
+            raise ValueError(
+                "host_overlap=True is unsupported with cp_mesh: CP admits "
+                "per-sequence through prefill_cp and its multi-process "
+                "host_np collectives must line up SPMD-identically across "
+                "processes — a lagged commit would reorder them.  Run CP "
+                "engines with host_overlap=False")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],
@@ -1249,6 +1446,10 @@ class InferenceEngine(EngineBase):
         self.params = params
         self.tokenizer = tokenizer
         self._draft = setup_draft(draft_model, model_cfg, engine_cfg)
+        if self._draft is not None:
+            # the draft model's own token fetch is a real sync point
+            self._draft.on_sync = (
+                lambda: self._count("engine.d2h_syncs"))
         self.sampling = SamplingParams(
             temperature=engine_cfg.temperature,
             top_k=engine_cfg.top_k,
@@ -1348,6 +1549,15 @@ class InferenceEngine(EngineBase):
         self.lengths = jnp.zeros((b,), jnp.int32)
         self.cur_tokens = jnp.zeros((b,), jnp.int32)
         self._key = jax.random.PRNGKey(engine_cfg.seed)
+        # overlapped hot loop state (EngineBase machinery)
+        self._overlap = engine_cfg.host_overlap
+        self._inflight = []
+        self._admit_pending = []
+        self._flushed_out = []
+        # fused-step clamp: retired slots keep advancing until the flush
+        # notices; their writes stay inside row capacity and are
+        # overwritten by any re-admission's prefill before first attended
+        self._overlap_cap = engine_cfg.max_seq_len - 1
 
         self._free_slots = list(range(b))
         self._active: Dict[int, _Active] = {}       # slot -> state
@@ -1424,6 +1634,14 @@ class InferenceEngine(EngineBase):
             pp_decode_fn if pp_decode_fn is not None
             else functools.partial(llama.decode_step, ep_mesh=ep_mesh),
             static_argnums=0)
+        # fused overlapped step (engine.overlap_step): decode + key split
+        # + sample + length advance in ONE dispatch.  The in-jit
+        # jax.random.split computes the identical subkey stream as the
+        # host split in the plain tick, so sampled tokens match exactly.
+        self._overlap_decode = jax.jit(
+            functools.partial(overlap_step, ep_mesh=ep_mesh,
+                              decode_fn=pp_decode_fn),
+            static_argnums=(0, 6, 7))
         if pp_mesh is not None:
             def _verify_step(cfg, params_t, cache, tokens, lengths):
                 p, stk = params_t
@@ -1476,8 +1694,20 @@ class InferenceEngine(EngineBase):
         """One engine tick: admit pending into free slots, then one decode
         step for all active slots.  Returns sequences finished this tick.
         (Fault polling and tracing live in EngineBase.step, the public
-        pump surface.)"""
+        pump surface.)
+
+        With host_overlap on and no grammar/speculation/scan in play, the
+        decode dispatch is the fused ``overlap_step`` and the host commit
+        lags one-to-two ticks behind (_overlap_step_tick); every other
+        path flushes the lag first, so it observes fully committed
+        state."""
         finished: List[SequenceResult] = []
+        if self._flushed_out:
+            finished.extend(self._flushed_out)
+            self._flushed_out = []
+        fast = self._overlap_fast()
+        if self._inflight and not fast:
+            finished.extend(self._overlap_flush())
         while self._pending and self._free_slots:
             group = self._admission_group()
             # PP has no single-sequence prefill: every admission goes
@@ -1489,7 +1719,13 @@ class InferenceEngine(EngineBase):
                     finished.append(early)
             else:
                 finished.extend(self._admit_batch(group))
+        if not fast:
+            # one coalesced fetch commits every deferred admission first
+            # token before any state-dependent path (spec drafts, scan
+            # chunk bounds) reads st.generated
+            finished.extend(self._drain_admission_commits())
         if not self._active:
+            finished.extend(self._overlap_flush())
             return finished
 
         if self._speculation_applies():
@@ -1501,11 +1737,16 @@ class InferenceEngine(EngineBase):
             finished.extend(self._scan_tick(chunk))
             return finished
 
+        if fast:
+            finished.extend(self._overlap_step_tick())
+            return finished
+
         active_slots = list(self._active)
         forced, allow = self._tick_constraints(
             active_slots, self.engine_cfg.max_batch,
             self.model_cfg.vocab_size)
         with profiling.annotate("engine.decode_step"):
+            self._count("engine.dispatches")
             self.cache, logits = self._decode(
                 self.model_cfg, self.params, self.cache,
                 self.cur_tokens, self.lengths)
@@ -1518,16 +1759,18 @@ class InferenceEngine(EngineBase):
         self._count("engine.decode_tokens", len(self._active))
 
         self.lengths = self.lengths.at[jnp.asarray(active_slots)].add(1)
+        # ONE coalesced fetch for tokens + lengths (two blocking syncs
+        # before the hot-loop rework)
+        host_next, lengths_host = self._fetch(next_tokens, self.lengths)
         if forced:
             # np.asarray of a device array is a read-only view; copy to edit
-            host_next = host_np(next_tokens).copy()
+            host_next = host_next.copy()
             for slot, token in forced.items():
                 host_next[slot] = token
+            self._count("engine.h2d_uploads")
             self.cur_tokens = jnp.asarray(host_next)
         else:
-            host_next = host_np(next_tokens)
             self.cur_tokens = next_tokens
-        lengths_host = host_np(self.lengths)
 
         for slot in active_slots:
             st = self._active[slot]
@@ -1539,6 +1782,27 @@ class InferenceEngine(EngineBase):
             if reason is not None:
                 finished.append(self._retire(slot, reason))
         return finished
+
+    def _overlap_step_tick(self) -> List[SequenceResult]:
+        """Fast-path tick body: ONE fused dispatch (decode + sample +
+        length advance, RNG key carried in-jit), no blocking fetch — the
+        token vector joins ``_inflight`` and commits when the lag flushes
+        (every ``_overlap_lag`` ticks, one coalesced sync).  decode_tokens
+        are counted at commit (in _commit_scanned), so totals match the
+        plain path exactly."""
+        admits = self._take_admit_pending()
+        slots = [(s, self._active[s].seq_id) for s in sorted(self._active)]
+        with profiling.annotate("engine.decode_step"):
+            self._count("engine.dispatches")
+            self.cache, nxt, self.lengths, self._key = self._overlap_decode(
+                self.model_cfg, self.params, self.cache, self.cur_tokens,
+                self.lengths, self._key, self.sampling, self._overlap_cap)
+        self.cur_tokens = nxt
+        self._inflight.append({"slots": slots, "toks": nxt,
+                               "admits": admits})
+        if len(self._inflight) >= self._overlap_lag:
+            return self._overlap_flush()
+        return []
 
     # ------------------------------------------------------------- internals
 
@@ -1556,39 +1820,65 @@ class InferenceEngine(EngineBase):
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req.prompt_ids
         with profiling.annotate("engine.prefill"):
+            self._count("engine.dispatches")
             self.cache, logits = self._prefill(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(padded), jnp.int32(n), jnp.int32(slot))
             self._key, sub = jax.random.split(self._key)
             first = self._sample(logits, sub, self.sampling)
         self._count("engine.prefill_tokens", n)
-        return self._activate(req, slot, logits, int(host_np(first)[0]))
+        if req.grammar is not None:
+            # grammar first tokens stay synchronous: the FSM needs the
+            # sampled value (and possibly a masked resample off these
+            # logits) before the next dispatch
+            return self._activate(req, slot, logits,
+                                  int(self._fetch(first)[0][0]))
+        # deferred admission: the device already has the first token (the
+        # decode input), the HOST value commits at the next coalesced
+        # drain/flush — admission no longer blocks on a per-group sync
+        st = self._preactivate(req, slot)
+        self.cur_tokens = self.cur_tokens.at[slot].set(first[0])
+        self._defer_first(st, first, 0)
+        return None
 
-    def _activate(self, req: _Pending, slot: int, logits_1v,
-                  first_token: int) -> Optional[SequenceResult]:
-        """Shared post-prefill bookkeeping: grammar-constrain the first
-        token, register the slot, early-retire if already terminal."""
+    def _preactivate(self, req: _Pending, slot: int) -> _Active:
+        """Token-independent half of activation: register the slot and
+        set its device length (the first token is handled separately —
+        synchronously for grammar slots, deferred otherwise)."""
         n = len(req.prompt_ids)
         st = _Active(
             seq_id=req.seq_id, slot=slot, prompt_tokens=n,
             max_new_tokens=req.max_new_tokens, stop_strings=req.stop_strings,
             grammar=req.grammar)
+        self._active[slot] = st
+        self.lengths = self.lengths.at[slot].set(n)
+        return st
+
+    def _note_first_token(self, slot: int, token: int,
+                          update_dev: bool) -> None:
+        # deferred admissions already wrote the on-device first token at
+        # _defer_first time; only the grammar path (whose constrained
+        # token can differ from the sampled one) and pre-dispatch drains
+        # write it here.  update_dev=False at a lagged flush: the device
+        # vector has advanced past the first token.
+        if update_dev:
+            self.cur_tokens = self.cur_tokens.at[slot].set(token)
+
+    def _activate(self, req: _Pending, slot: int, logits_1v,
+                  first_token: int) -> Optional[SequenceResult]:
+        """Synchronous activation: grammar-constrain the first token,
+        register the slot, early-retire if already terminal."""
+        st = self._preactivate(req, slot)
         token = first_token
         if st.grammar is not None:
             remaining = min(st.max_new_tokens,
-                            self.engine_cfg.max_seq_len - n - 1)
+                            self.engine_cfg.max_seq_len
+                            - st.prompt_tokens - 1)
             token = self._grammar_first_token(st.grammar, logits_1v, token,
                                               remaining)
             st.grammar.advance(token)
-        st.generated.append(token)
-        self._active[slot] = st
-        self.lengths = self.lengths.at[slot].set(n)
-        self.cur_tokens = self.cur_tokens.at[slot].set(token)
         # the first sampled token may already terminate the sequence
-        reason = self._finish_reason(st, token, n)
-        if reason is not None:
-            return self._retire(slot, reason)
-        return None
+        return self._commit_first(st, token, update_dev=True)
 
     def _admission_group(self) -> List[_Pending]:
         """Pop a FIFO run of pending requests sharing one prefill bucket,
@@ -1631,6 +1921,7 @@ class InferenceEngine(EngineBase):
         slot_arr[n:] = slot_arr[n - 1]
 
         with profiling.annotate("engine.prefill"):
+            self._count("engine.dispatches")
             self.cache, logits = self._prefill_batch(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(tokens), jnp.asarray(lens),
@@ -1640,14 +1931,22 @@ class InferenceEngine(EngineBase):
         self._count("engine.prefill_tokens", int(lens[:n].sum()))
         self._count("engine.batched_admissions", n)
 
-        finished: List[SequenceResult] = []
-        firsts_host = host_np(firsts)
+        if any(r.grammar is not None for r in reqs):
+            # a grammar member forces the whole group synchronous so its
+            # masked-resample key split keeps its stream position
+            finished: List[SequenceResult] = []
+            (firsts_host,) = self._fetch(firsts)
+            for i, req in enumerate(reqs):
+                early = self._activate(req, slots[i], logits[i:i + 1],
+                                       int(firsts_host[i]))
+                if early is not None:
+                    finished.append(early)
+            return finished
         for i, req in enumerate(reqs):
-            early = self._activate(req, slots[i], logits[i:i + 1],
-                                   int(firsts_host[i]))
-            if early is not None:
-                finished.append(early)
-        return finished
+            st = self._preactivate(req, slots[i])
+            self.cur_tokens = self.cur_tokens.at[slots[i]].set(firsts[i])
+            self._defer_first(st, firsts, i)
+        return []
 
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)
@@ -1679,6 +1978,7 @@ class InferenceEngine(EngineBase):
         active_slots = list(self._active)
         setup = self._scan_dfa_setup()
         self._key, sub = jax.random.split(self._key)
+        self._count("engine.dispatches")
         if setup is None:
             with profiling.annotate("engine.decode_step"):
                 self.cache, toks, self.lengths = self._decode_scan(
@@ -1695,7 +1995,7 @@ class InferenceEngine(EngineBase):
                     self.sampling, self.tokenizer.eos_id,
                     jnp.asarray(states), jnp.asarray(remaining),
                     allow_t, next_t, dist_t, close_t, complete_t)
-        toks_host = host_np(toks)                        # [chunk, B]
+        (toks_host,) = self._fetch(toks)                 # [chunk, B]
         self.cur_tokens = toks[-1]
 
         return self._commit_scanned(active_slots, toks_host, chunk,
@@ -1710,17 +2010,18 @@ class InferenceEngine(EngineBase):
         greedy is computed ON DEVICE (dfa_greedy_multi) — spec×grammar
         keeps multi-token verify with no [B, T, V] logits transfer."""
         active_slots = list(self._active)
-        cur_host = host_np(self.cur_tokens)
+        cur_host, lengths_host = self._fetch(self.cur_tokens, self.lengths)
         tokens_in, drafts = self._build_drafts(active_slots, cur_host)
 
         with profiling.annotate("engine.decode_step"):
+            self._count("engine.dispatches")
             self.cache, greedy, logits = self._decode_multi(
                 self.model_cfg, self.params, self.cache,
                 jnp.asarray(tokens_in), self.lengths)
             greedy_host, logits_host, constrained = \
                 self._spec_constrained_greedy(greedy, logits, active_slots)
 
-        lengths_host = host_np(self.lengths).copy()
+        lengths_host = lengths_host.copy()
         next_cur = cur_host.copy()
 
         def post_commit(slot: int, token: int) -> None:
@@ -1730,6 +2031,7 @@ class InferenceEngine(EngineBase):
         finished = self._verify_and_commit(active_slots, drafts, greedy_host,
                                            logits_host, post_commit,
                                            constrained)
+        self._count("engine.h2d_uploads", 2)
         self.lengths = jnp.asarray(lengths_host)
         self.cur_tokens = jnp.asarray(next_cur)
         return finished
@@ -1738,6 +2040,42 @@ class InferenceEngine(EngineBase):
 # ---------------------------------------------------------------------------
 # On-device multi-step decode (throughput path, used by bench.py)
 # ---------------------------------------------------------------------------
+
+
+def overlap_step(
+    cfg: ModelConfig,
+    params,
+    cache: llama.KVCache,
+    cur_tokens: jnp.ndarray,    # [B]
+    lengths: jnp.ndarray,       # [B]
+    key: jax.Array,
+    sampling: SamplingParams,
+    cap: int,
+    ep_mesh=None,
+    decode_fn=None,
+) -> Tuple[llama.KVCache, jnp.ndarray, jnp.ndarray, jax.Array]:
+    """One fused hot-loop step for the overlapped engine: decode + RNG
+    split + sample + length advance in a single dispatch, so the host
+    never touches the carried state between ticks.
+
+    ``jax.random.split`` is deterministic, so splitting in-jit yields the
+    identical subkey stream as the plain tick's host-side split — sampled
+    tokens match token-for-token.  ALL slots advance (clamped at ``cap``,
+    the last writable cache position): a slot whose sequence already
+    finished on the host keeps decoding garbage until the lagged flush
+    retires it, which is safe because its tokens are never committed and
+    its KV row is fully rewritten by the next admission's prefill before
+    any position is attended.  Returns (cache, next_tokens, lengths, key).
+    """
+    if decode_fn is None:
+        cache, logits = llama.decode_step(cfg, params, cache, cur_tokens,
+                                          lengths, ep_mesh)
+    else:
+        cache, logits = decode_fn(cfg, params, cache, cur_tokens, lengths)
+    key, sub = jax.random.split(key)
+    nxt = sample_tokens(logits, sub, sampling)
+    lengths = jnp.minimum(lengths + 1, cap).astype(lengths.dtype)
+    return cache, nxt, lengths, key
 
 
 def decode_scan(

@@ -37,7 +37,7 @@ import numpy as np
 from k8s_llm_rca_tpu.config import EngineConfig, ModelConfig
 from k8s_llm_rca_tpu.engine.engine import (
     EngineBase, SequenceResult, _Active, _Pending, flash_prefill_plan,
-    host_np, validate_cp_divisibility,
+    validate_cp_divisibility,
 )
 from k8s_llm_rca_tpu.engine.sampling import (
     SamplingParams, sample_tokens, sample_tokens_masked,
@@ -818,6 +818,39 @@ def paged_decode_scan_dfa(cfg: ModelConfig, params, pool: PagePool,
     return pool, toks, lengths, states
 
 
+def paged_overlap_step(cfg: ModelConfig, params, pool: PagePool,
+                       cur_tokens: jnp.ndarray, lengths: jnp.ndarray,
+                       block_tables: jnp.ndarray, key,
+                       sampling: SamplingParams, cap: int,
+                       use_kernel: Optional[bool] = None, ep_mesh=None,
+                       tp_mesh=None, decode_fn=None):
+    """One fused hot-loop step for the overlapped paged engine: decode +
+    RNG split + sample + length advance in a single dispatch over the
+    device-resident state (docs/performance.md).
+
+    ``jax.random.split`` is deterministic, so splitting in-jit yields the
+    identical subkey stream as the plain tick's host-side split — sampled
+    tokens match token-for-token.  ALL slots advance (clamped at ``cap``,
+    the last in-table position): a slot whose sequence already finished
+    on the host keeps decoding garbage until the lagged flush retires it,
+    which is safe because its tokens are never committed and its block-
+    table row is reset to the trash page at retirement, so the garbage KV
+    lands in page 0 (never attended).  Returns (pool', next_tokens,
+    lengths', key')."""
+    if decode_fn is None:
+        pool, logits = paged_decode_step(cfg, params, pool, cur_tokens,
+                                         lengths, block_tables,
+                                         use_kernel=use_kernel,
+                                         ep_mesh=ep_mesh, tp_mesh=tp_mesh)
+    else:
+        pool, logits = decode_fn(cfg, params, pool, cur_tokens, lengths,
+                                 block_tables)
+    key, sub = jax.random.split(key)
+    nxt = sample_tokens(logits, sub, sampling)
+    lengths = jnp.minimum(lengths + 1, cap).astype(lengths.dtype)
+    return pool, nxt, lengths, key
+
+
 # ---------------------------------------------------------------------------
 # engine
 # ---------------------------------------------------------------------------
@@ -925,6 +958,13 @@ class PagedInferenceEngine(EngineBase):
                                   and jax.default_backend() == "tpu")
             if use_kernel:
                 self._kernel_mesh = tp_mesh
+        if engine_cfg.host_overlap and cp_mesh is not None:
+            raise ValueError(
+                "host_overlap=True is unsupported with cp_mesh: CP admits "
+                "per-sequence through prefill_kv_cp and its multi-process "
+                "host_np collectives must line up SPMD-identically across "
+                "processes — a lagged commit would reorder them; serve CP "
+                "engines with host_overlap=False")
         self._cp_parts = 0
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
@@ -966,6 +1006,11 @@ class PagedInferenceEngine(EngineBase):
         from k8s_llm_rca_tpu.engine.engine import setup_draft
 
         self._draft = setup_draft(draft_model, model_cfg, engine_cfg)
+        if self._draft is not None:
+            # account the draft scan's blocking token fetch with the
+            # engine's own sync counter (docs/performance.md)
+            self._draft.on_sync = (
+                lambda: self._count("engine.d2h_syncs"))
         self.sampling = SamplingParams(
             temperature=engine_cfg.temperature,
             top_k=engine_cfg.top_k,
@@ -1079,6 +1124,25 @@ class PagedInferenceEngine(EngineBase):
         self.lengths = np.zeros((b,), np.int64)
         self.cur_tokens = np.zeros((b,), np.int64)
         self._key = jax.random.PRNGKey(engine_cfg.seed)
+        # overlapped hot loop state (EngineBase machinery + the paged
+        # device-resident mirrors; docs/performance.md).  _dev_* hold the
+        # decode operands on device between ticks; _dev_dirty is the
+        # single invalidation point (host mirrors changed wholesale —
+        # re-upload before the next dispatch).  _inflight_n counts each
+        # slot's dispatched-but-uncommitted fast-path steps so growth
+        # covers the DEVICE length, not the lagging host mirror.
+        self._overlap = engine_cfg.host_overlap
+        self._inflight = []
+        self._admit_pending = []
+        self._flushed_out = []
+        self._inflight_n: Dict[int, int] = {}
+        self._dev_cur = None
+        self._dev_lens = None
+        self._dev_bt = None
+        self._dev_dirty = True
+        # fused-step clamp: the last in-table position (see
+        # paged_overlap_step's garbage-containment contract)
+        self._dev_cap = self.pages_per_seq * self.page_size - 1
 
         self._free_slots = list(range(b))
         self._active: Dict[int, _Active] = {}
@@ -1205,6 +1269,17 @@ class PagedInferenceEngine(EngineBase):
                                     tp_mesh=self._kernel_mesh),
             static_argnums=(0,),
             donate_argnums=donate, static_argnames=("use_kernel",))
+        # fused overlapped step (paged_overlap_step): decode + key split
+        # + sample + length advance in ONE dispatch over the device-
+        # resident state.  The in-jit jax.random.split computes the
+        # identical subkey stream as the host split in the plain tick,
+        # so sampled tokens match exactly.
+        self._overlap_decode = jax.jit(
+            functools.partial(paged_overlap_step, ep_mesh=ep_mesh,
+                              tp_mesh=self._kernel_mesh,
+                              decode_fn=pp_decode_fn),
+            static_argnums=(0, 7, 8),
+            donate_argnums=donate, static_argnames=("use_kernel",))
         self._decode_scan = jax.jit(
             functools.partial(paged_decode_scan, ep_mesh=ep_mesh,
                               tp_mesh=self._kernel_mesh,
@@ -1303,18 +1378,120 @@ class PagedInferenceEngine(EngineBase):
                                 if self.prefix_cache is not None else 0)
         return g
 
+    # --------------------------------------------- device-resident state
+
+    def _device_state(self):
+        """The decode operands as device arrays (docs/performance.md).
+
+        Plain mode uploads the three host mirrors every call — the
+        pre-overlap behavior, now visible in ``engine.h2d_uploads``.
+        Overlap mode keeps them device-resident: upload ONCE when dirty
+        (host mirrors changed wholesale: sync-path commits, speculation,
+        restore, faults), then mirror individual host writes with cheap
+        ``.at[].set`` edits — steady-state ticks upload nothing."""
+        if not self._overlap:
+            self._count("engine.h2d_uploads", 3)
+            return (jnp.asarray(self.cur_tokens, jnp.int32),
+                    jnp.asarray(self.lengths, jnp.int32),
+                    jnp.asarray(self.block_tables))
+        if self._dev_dirty:
+            self._count("engine.h2d_uploads", 3)
+            self._dev_cur = jnp.asarray(self.cur_tokens, jnp.int32)
+            self._dev_lens = jnp.asarray(self.lengths, jnp.int32)
+            self._dev_bt = jnp.asarray(self.block_tables)
+            self._dev_dirty = False
+            # deferred admissions' first tokens exist only on device (the
+            # host mirror is stale until the next drain/flush); re-apply
+            # them on top of the fresh upload
+            for st, a, i in self._admit_pending:
+                if self._active.get(st.slot) is st:
+                    self._dev_cur = self._dev_cur.at[st.slot].set(a[i])
+        return self._dev_cur, self._dev_lens, self._dev_bt
+
+    def _invalidate_device_state(self) -> None:
+        self._dev_dirty = True
+
+    def _dev_edit_token(self, slot: int, token) -> None:
+        """Mirror one host ``cur_tokens`` write into the resident device
+        array (an ``.at[].set`` edit, not a full upload — uncounted by
+        design; ``token`` may be a host int or a device scalar)."""
+        if self._overlap and not self._dev_dirty:
+            self._dev_cur = self._dev_cur.at[slot].set(token)
+
+    def _dev_edit_len(self, slot: int, n: int) -> None:
+        if self._overlap and not self._dev_dirty:
+            self._dev_lens = self._dev_lens.at[slot].set(n)
+
+    def _dev_edit_bt_row(self, slot: int) -> None:
+        """Mirror one block-table row after a host-side write (growth,
+        admission, retirement/preemption trash reset).  Keeping retired
+        rows at TRASH_PAGE on device is what contains the fused step's
+        garbage writes to page 0 (paged_overlap_step)."""
+        if self._overlap and not self._dev_dirty:
+            self._dev_bt = self._dev_bt.at[slot].set(
+                jnp.asarray(self.block_tables[slot]))
+
+    def _covered_len(self, slot: int) -> int:
+        """Logical sequence length INCLUDING dispatched-but-uncommitted
+        fast-path steps — what growth must cover so a lagged tick never
+        writes into an unallocated page."""
+        return int(self.lengths[slot]) + self._inflight_n.get(slot, 0)
+
+    def _note_flush_entry(self, entry: dict) -> None:
+        # every slot in the entry was dispatched once, live or not
+        for s, _ in entry["slots"]:
+            n = self._inflight_n.get(s, 0) - 1
+            if n > 0:
+                self._inflight_n[s] = n
+            else:
+                self._inflight_n.pop(s, None)
+
+    def _overlap_post_commit(self, slot: int, token: int) -> None:
+        # lagged-flush commit: host mirrors catch up to where the device
+        # already is, so the resident state stays CLEAN
+        self.lengths[slot] += 1
+        self.cur_tokens[slot] = token
+
+    def _note_first_token(self, slot: int, token: int,
+                          update_dev: bool) -> None:
+        self.cur_tokens[slot] = token
+        if update_dev:
+            # grammar-constrained first tokens can differ from the
+            # sampled device value; deferred admissions already hold the
+            # right value (written at _admit time), making this a
+            # same-value no-op edit.  update_dev=False at a lagged
+            # flush: the device array has advanced past the first token.
+            self._dev_edit_token(slot, token)
+
     def _tick(self) -> List[SequenceResult]:
         finished: List[SequenceResult] = []
+        if self._flushed_out:
+            # results finished by an out-of-tick flush (cancel/snapshot/
+            # fault barrier) surface here so step() callers never lose them
+            finished.extend(self._flushed_out)
+            self._flushed_out = []
+        fast = self._overlap_fast()
+        if self._inflight and not fast:
+            # a sync path (grammar, speculation, scan) runs this tick:
+            # commit the lag first so it observes fully committed state
+            finished.extend(self._overlap_flush())
         if self._pending and self._free_slots:
             with profiling.annotate("engine.tick.admission"):
                 finished.extend(self._tick_admission())
+        if not fast:
+            # one coalesced fetch commits every deferred admission first
+            # token before any state-dependent path (spec drafts, scan
+            # chunk bounds, a dirty re-upload) reads host mirrors
+            finished.extend(self._drain_admission_commits())
         if not self._active:
+            finished.extend(self._overlap_flush())
             return finished
 
         with profiling.annotate("engine.tick.eviction"):
             self._tick_growth()
         active_slots = sorted(self._active)
         if not active_slots:
+            finished.extend(self._overlap_flush())
             return finished
 
         if self._speculation_applies():
@@ -1326,15 +1503,19 @@ class PagedInferenceEngine(EngineBase):
             finished.extend(self._scan_tick(chunk, active_slots))
             return finished
 
+        if fast:
+            finished.extend(self._overlap_step_tick(active_slots))
+            return finished
+
         forced, allow = self._tick_constraints(
             active_slots, self.engine_cfg.max_batch,
             self.model_cfg.vocab_size)
+        cur_d, lens_d, bt_d = self._device_state()
         with profiling.annotate("engine.decode_step"):
+            self._count("engine.dispatches")
             self.pool, logits = self._decode(
                 self.model_cfg, self.params, self.pool,
-                jnp.asarray(self.cur_tokens, jnp.int32),
-                jnp.asarray(self.lengths, jnp.int32),
-                jnp.asarray(self.block_tables),
+                cur_d, lens_d, bt_d,
                 use_kernel=self.use_kernel)
             self._key, sub = jax.random.split(self._key)
             if allow is not None:
@@ -1344,7 +1525,7 @@ class PagedInferenceEngine(EngineBase):
                 next_tokens = self._sample(logits, sub, self.sampling)
         self._count("engine.decode_tokens", len(active_slots))
 
-        host_next = host_np(next_tokens)
+        (host_next,) = self._fetch(next_tokens)
         for slot in active_slots:
             self.lengths[slot] += 1
             st = self._active[slot]
@@ -1356,7 +1537,37 @@ class PagedInferenceEngine(EngineBase):
             reason = self._finish_reason(st, token, int(self.lengths[slot]))
             if reason is not None:
                 finished.append(self._retire(slot, reason))
+        # the plain step does not advance the device lengths/tokens; the
+        # host commit above is authoritative — re-upload next dispatch
+        self._invalidate_device_state()
         return finished
+
+    def _overlap_step_tick(self, active_slots) -> List[SequenceResult]:
+        """Fast-path paged tick: ONE fused dispatch over the device-
+        resident state, no blocking fetch — the token vector joins
+        ``_inflight`` and commits when the lag flushes.  decode_tokens
+        are counted at commit (_commit_scanned), so totals match the
+        plain path exactly."""
+        # device state FIRST: a dirty upload re-applies _admit_pending
+        # device tokens over the stale host mirror, so take the admits
+        # only after the resident arrays are materialised
+        cur_d, lens_d, bt_d = self._device_state()
+        admits = self._take_admit_pending()
+        slots = [(s, self._active[s].seq_id) for s in active_slots]
+        with profiling.annotate("engine.decode_step"):
+            self._count("engine.dispatches")
+            self.pool, nxt, new_lens, self._key = self._overlap_decode(
+                self.model_cfg, self.params, self.pool, cur_d, lens_d,
+                bt_d, self._key, self.sampling, self._dev_cap,
+                use_kernel=self.use_kernel)
+        self._dev_cur, self._dev_lens = nxt, new_lens
+        for s in active_slots:
+            self._inflight_n[s] = self._inflight_n.get(s, 0) + 1
+        self._inflight.append({"slots": slots, "toks": nxt,
+                               "admits": admits})
+        if len(self._inflight) >= self._overlap_lag:
+            return self._overlap_flush()
+        return []
 
     def _tick_admission(self) -> List[SequenceResult]:
         """Admit pending requests into free slots (the tick's admission
@@ -1411,7 +1622,10 @@ class PagedInferenceEngine(EngineBase):
             if slot not in self._active:
                 # a previous iteration's _preempt_youngest() evicted it
                 continue
-            if self.lengths[slot] % self.page_size == 0:
+            # _covered_len, not the host mirror: with a lagged commit the
+            # device is up to _overlap_lag steps ahead, and the NEXT
+            # dispatch writes at the device length
+            if self._covered_len(slot) % self.page_size == 0:
                 # keep evicting youngest-first until the grow succeeds: one
                 # eviction is always enough for the plain pool, but under
                 # the CP seq-sharded pool the freed pages may fall in a
@@ -1429,9 +1643,10 @@ class PagedInferenceEngine(EngineBase):
         if chunk_goal > 1:
             for slot in sorted(self._active):
                 st = self._active[slot]
-                pos = int(self.lengths[slot])
+                pos = self._covered_len(slot)
                 last = min(pos + chunk_goal - 1,
                            self.pages_per_seq * self.page_size - 1)
+                grew = False
                 for idx in range(pos // self.page_size + 1,
                                  last // self.page_size + 1):
                     if self.block_tables[slot, idx] != TRASH_PAGE:
@@ -1449,6 +1664,9 @@ class PagedInferenceEngine(EngineBase):
                     except OutOfPages:
                         break          # best-effort: bound shrinks instead
                     self.block_tables[slot, idx] = page
+                    grew = True
+                if grew:
+                    self._dev_edit_bt_row(slot)
 
     # --------------------------------------------- speculative decoding
 
@@ -1466,7 +1684,12 @@ class PagedInferenceEngine(EngineBase):
         sharing one compiled DFA verify constrained ON DEVICE
         (engine.dfa_greedy_multi) — no [B, T, V] logits transfer."""
         tokens_in, drafts = self._build_drafts(active_slots, self.cur_tokens)
+        # the verify step reshapes the batch to [B, T] drafts, so it
+        # cannot reuse the resident [B] cur array; lengths + block tables
+        # are the named-array uploads it pays
+        self._count("engine.h2d_uploads", 2)
         with profiling.annotate("engine.decode_step"):
+            self._count("engine.dispatches")
             self.pool, greedy, logits = self._decode_multi(
                 self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens_in), jnp.asarray(self.lengths, jnp.int32),
@@ -1478,9 +1701,13 @@ class PagedInferenceEngine(EngineBase):
             self.lengths[slot] += 1
             self.cur_tokens[slot] = token
 
-        return self._verify_and_commit(active_slots, drafts, greedy_host,
-                                       logits_host, post_commit,
-                                       constrained)
+        out = self._verify_and_commit(active_slots, drafts, greedy_host,
+                                      logits_host, post_commit,
+                                      constrained)
+        # host mirrors advanced by a variable accepted count per slot —
+        # single invalidation point, re-upload before the next dispatch
+        self._invalidate_device_state()
+        return out
 
     # ------------------------------------------------- chunked scan tick
 
@@ -1504,29 +1731,34 @@ class PagedInferenceEngine(EngineBase):
         accounting identical to the stepwise tick (shared commit loop)."""
         setup = self._scan_dfa_setup()
         self._key, sub = jax.random.split(self._key)
+        cur_d, lens_d, bt_d = self._device_state()
         if setup is None:
             with profiling.annotate("engine.decode_step"):
-                self.pool, toks, _ = self._decode_scan(
+                self._count("engine.dispatches")
+                self.pool, toks, new_lens = self._decode_scan(
                     self.model_cfg, self.params, self.pool,
-                    jnp.asarray(self.cur_tokens, jnp.int32),
-                    jnp.asarray(self.lengths, jnp.int32),
-                    jnp.asarray(self.block_tables), sub, chunk,
+                    cur_d, lens_d, bt_d, sub, chunk,
                     self.sampling, self.tokenizer.eos_id,
                     use_kernel=self.use_kernel)
         else:
             (allow_t, next_t, dist_t, close_t, complete_t), states, \
                 remaining = setup
             with profiling.annotate("engine.decode_step"):
-                self.pool, toks, _, _ = self._decode_scan_dfa(
+                self._count("engine.dispatches")
+                self.pool, toks, new_lens, _ = self._decode_scan_dfa(
                     self.model_cfg, self.params, self.pool,
-                    jnp.asarray(self.cur_tokens, jnp.int32),
-                    jnp.asarray(self.lengths, jnp.int32),
-                    jnp.asarray(self.block_tables), sub, chunk,
+                    cur_d, lens_d, bt_d, sub, chunk,
                     self.sampling, self.tokenizer.eos_id,
                     jnp.asarray(states), jnp.asarray(remaining),
                     allow_t, next_t, dist_t, close_t, complete_t,
                     use_kernel=self.use_kernel)
-        toks_host = host_np(toks)                       # [chunk, B]
+        if self._overlap:
+            # surviving slots' host mirrors advance to EXACTLY these
+            # values in the commit loop below (a slot that stops short is
+            # always retired, trashing its row), so the resident state
+            # stays clean: the next scan dispatches with zero uploads
+            self._dev_cur, self._dev_lens = toks[-1], new_lens
+        (toks_host,) = self._fetch(toks)                # [chunk, B]
 
         def post_commit(slot: int, token: int) -> None:
             self.lengths[slot] += 1
@@ -1701,6 +1933,7 @@ class PagedInferenceEngine(EngineBase):
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(rest)] = rest
         with profiling.annotate("engine.prefill"):
+            self._count("engine.dispatches")
             if n_cached:
                 # pad the prefix table to the next power of two of page
                 # counts: the chunk-prefill gathers/attends over the whole
@@ -1726,15 +1959,28 @@ class PagedInferenceEngine(EngineBase):
             first = self._sample(logits, sub, self.sampling)
         self._count("engine.prefill_tokens", len(rest))
 
-        return self._activate_paged(req, slot, table, n_cp, logits,
-                                    int(host_np(first)[0]))
+        if req.grammar is not None:
+            # grammar first tokens stay synchronous: the FSM needs the
+            # sampled value (and possibly a masked resample off these
+            # logits) before the next dispatch
+            return self._activate_paged(req, slot, table, n_cp, logits,
+                                        int(self._fetch(first)[0][0]))
+        # deferred admission (docs/performance.md): the device value goes
+        # straight into the resident cur array; the HOST value lands at
+        # the next coalesced drain/flush — single-sequence admission no
+        # longer pays a blocking per-admission fetch (it used to cost one
+        # ~0.25 s tunnel round-trip per admission)
+        st = self._preactivate_paged(req, slot, table, n_cp)
+        self._dev_edit_token(slot, first[0])
+        self._defer_first(st, first, 0)
+        return None
 
-    def _activate_paged(self, req: _Pending, slot: int, table, n_cp: int,
-                        logits_1v, first_token: int
-                        ) -> Optional[SequenceResult]:
-        """Shared post-prefill bookkeeping (single and batched admission):
-        chain pages into the prefix cache, grammar-constrain the first
-        token, register the slot, early-retire if already terminal."""
+    def _preactivate_paged(self, req: _Pending, slot: int, table,
+                           n_cp: int) -> _Active:
+        """Token-independent half of paged activation: chain pages into
+        the prefix cache, register the slot, set its length and block-
+        table mirrors (the first token is handled separately —
+        synchronously for grammar slots, deferred otherwise)."""
         n = len(req.prompt_ids)
         n_shared = n_cp
         if self.prefix_cache is not None:
@@ -1744,21 +1990,28 @@ class PagedInferenceEngine(EngineBase):
                      max_new_tokens=req.max_new_tokens,
                      stop_strings=req.stop_strings, grammar=req.grammar,
                      n_shared=n_shared)
+        self._active[slot] = st
+        self.lengths[slot] = n
+        self._dev_edit_len(slot, n)
+        self._dev_edit_bt_row(slot)
+        return st
+
+    def _activate_paged(self, req: _Pending, slot: int, table, n_cp: int,
+                        logits_1v, first_token: int
+                        ) -> Optional[SequenceResult]:
+        """Synchronous paged activation: grammar-constrain the first
+        token, register the slot, early-retire if already terminal."""
+        st = self._preactivate_paged(req, slot, table, n_cp)
         token = first_token
         if st.grammar is not None:
             remaining = min(st.max_new_tokens,
-                            self.engine_cfg.max_seq_len - n - 1)
+                            self.engine_cfg.max_seq_len
+                            - st.prompt_tokens - 1)
             token = self._grammar_first_token(st.grammar, logits_1v, token,
                                               remaining)
             st.grammar.advance(token)
-        st.generated.append(token)
-        self._active[slot] = st
-        self.lengths[slot] = n
-        self.cur_tokens[slot] = token
-        reason = self._finish_reason(st, token, n)
-        if reason is not None:
-            return self._retire(slot, reason)
-        return None
+        # the first sampled token may already terminate the sequence
+        return self._commit_first(st, token, update_dev=True)
 
     def _admit_batch_hits(self, reqs: List[_Pending],
                           matches: List[Tuple[List[int], int]]
@@ -1824,6 +2077,7 @@ class PagedInferenceEngine(EngineBase):
         maps[n:] = maps[n - 1]
 
         with profiling.annotate("engine.prefill"):
+            self._count("engine.dispatches")
             self.pool, logits = self._prefill_chunk_batch(
                 self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens), jnp.asarray(clens),
@@ -1836,15 +2090,24 @@ class PagedInferenceEngine(EngineBase):
         self._count("engine.prefix_hit_tokens", n_cached * n)
         self._count("engine.prefix_batch_hit_admissions", n)
 
-        finished: List[SequenceResult] = []
-        firsts_host = host_np(firsts)
-        for i, (req, m) in enumerate(zip(reqs, matches)):
-            early = self._activate_paged(req, slots[i], tables[i], n_cp,
-                                         logits[i:i + 1],
-                                         int(firsts_host[i]))
-            if early is not None:
-                finished.append(early)
-        return finished
+        if any(r.grammar is not None for r in reqs):
+            # grammar groups stay synchronous (FSM needs the values now)
+            finished: List[SequenceResult] = []
+            (firsts_host,) = self._fetch(firsts)
+            for i, (req, m) in enumerate(zip(reqs, matches)):
+                early = self._activate_paged(req, slots[i], tables[i], n_cp,
+                                             logits[i:i + 1],
+                                             int(firsts_host[i]))
+                if early is not None:
+                    finished.append(early)
+            return finished
+        # deferred batch admission: ONE coalesced fetch at the next
+        # drain/flush covers the whole wave (docs/performance.md)
+        for i, req in enumerate(reqs):
+            st = self._preactivate_paged(req, slots[i], tables[i], n_cp)
+            self._dev_edit_token(slots[i], firsts[i])
+            self._defer_first(st, firsts, i)
+        return []
 
     def _admit_batch(self, reqs: List[_Pending]) -> List[SequenceResult]:
         """Admit N same-bucket prefix-miss sequences with ONE batched
@@ -1891,6 +2154,7 @@ class PagedInferenceEngine(EngineBase):
         maps[n:] = maps[n - 1]
 
         with profiling.annotate("engine.prefill"):
+            self._count("engine.dispatches")
             self.pool, logits = self._prefill_batch(
                 self.model_cfg, self.params, self.pool,
                 jnp.asarray(tokens), jnp.asarray(lens), jnp.asarray(maps))
@@ -1899,19 +2163,30 @@ class PagedInferenceEngine(EngineBase):
         self._count("engine.prefill_tokens", int(lens[:n].sum()))
         self._count("engine.batched_admissions", n)
 
-        finished: List[SequenceResult] = []
-        firsts_host = host_np(firsts)
+        if any(r.grammar is not None for r in reqs):
+            # grammar groups stay synchronous (FSM needs the values now)
+            finished: List[SequenceResult] = []
+            (firsts_host,) = self._fetch(firsts)
+            for i, req in enumerate(reqs):
+                early = self._activate_paged(req, slots[i], tables[i], 0,
+                                             logits[i:i + 1],
+                                             int(firsts_host[i]))
+                if early is not None:
+                    finished.append(early)
+            return finished
+        # deferred batch admission: ONE coalesced fetch at the next
+        # drain/flush covers the whole wave (docs/performance.md)
         for i, req in enumerate(reqs):
-            early = self._activate_paged(req, slots[i], tables[i], 0,
-                                         logits[i:i + 1],
-                                         int(firsts_host[i]))
-            if early is not None:
-                finished.append(early)
-        return finished
+            st = self._preactivate_paged(req, slots[i], tables[i], 0)
+            self._dev_edit_token(slots[i], firsts[i])
+            self._defer_first(st, firsts, i)
+        return []
 
     def _grow(self, slot: int) -> None:
         st = self._active[slot]
-        idx = int(self.lengths[slot]) // self.page_size
+        # covered length: the next dispatch writes at the DEVICE length,
+        # which leads the host mirror by the in-flight lag
+        idx = self._covered_len(slot) // self.page_size
         if idx >= self.pages_per_seq:
             return                              # at cap; finish_reason handles
         if self.block_tables[slot, idx] != TRASH_PAGE:
@@ -1921,6 +2196,7 @@ class PagedInferenceEngine(EngineBase):
         else:
             (page,) = self._alloc_with_evict(1, owner=st.seq_id)
         self.block_tables[slot, idx] = page
+        self._dev_edit_bt_row(slot)
 
     def _preempt_youngest(self, exclude: Optional[int] = None) -> bool:
         """Evict the most-recently-admitted active sequence; requeue it."""
@@ -1946,6 +2222,7 @@ class PagedInferenceEngine(EngineBase):
         st = self._active.pop(slot)
         self._release_slot_pages(slot, st)
         self.block_tables[slot] = TRASH_PAGE
+        self._dev_edit_bt_row(slot)     # contain in-flight garbage writes
         self._free_slots.append(slot)
         # requeue at the FRONT with context so far; re-prefill resumes it.
         # generated-so-far moves into the resume prompt and is remembered in
@@ -1969,6 +2246,7 @@ class PagedInferenceEngine(EngineBase):
         self._release_slot_pages(slot, st)
         self.allocator.check()
         self.block_tables[slot] = TRASH_PAGE
+        self._dev_edit_bt_row(slot)     # contain in-flight garbage writes
         self._free_slots.append(slot)
         # a preempted-and-resumed sequence's st.generated holds only the
         # post-resume tokens; stitch the pre-preemption prefix back on and

@@ -96,6 +96,9 @@ class ModelDraft:
         self._prefill = jax.jit(llama.prefill, static_argnums=0)
         self._scan = jax.jit(decode_scan, static_argnums=(0, 6, 7, 8))
         self._key = jax.random.PRNGKey(0)              # greedy: unused noise
+        # owning engines hook this to account the draft scan's blocking
+        # token fetch in their engine.d2h_syncs counter (docs/performance.md)
+        self.on_sync = None
 
     def _bucket(self, n: int) -> int:
         for s in self._buckets:
@@ -163,6 +166,8 @@ class ModelDraft:
             jnp.asarray(self.lengths, jnp.int32),
             self._key, k + 1, self._greedy, eos_id)
         from k8s_llm_rca_tpu.engine.engine import host_np
+        if self.on_sync is not None:
+            self.on_sync()
         toks_host = host_np(toks)                      # [k+1, B]
         out = {}
         for s in active_slots:

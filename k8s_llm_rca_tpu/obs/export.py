@@ -96,6 +96,10 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                            "args": {"preemptions": s.preemptions,
                                     "admission_rejections":
                                     s.admission_rejections}})
+            events.append({**base, "name": "engine.host",
+                           "args": {"h2d_uploads": s.h2d_uploads,
+                                    "d2h_syncs": s.d2h_syncs,
+                                    "dispatches": s.dispatches}})
     # stable sort: equal-ts events keep recording order, so the document
     # is a pure function of the recording (byte-identity under VirtualClock)
     events.sort(key=lambda e: e["ts"])

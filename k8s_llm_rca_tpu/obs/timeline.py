@@ -36,6 +36,12 @@ class TickSample:
     prefix_hit_tokens: float = 0.0
     preemptions: float = 0.0
     admission_rejections: float = 0.0
+    # host<->device traffic (cumulative, docs/performance.md): full-array
+    # uploads of cur_tokens/lengths/block_tables, coalesced device->host
+    # fetch groups, and device dispatches (prefill/decode/scan/verify)
+    h2d_uploads: float = 0.0
+    d2h_syncs: float = 0.0
+    dispatches: float = 0.0
 
 
 class TickTimeline:

@@ -237,6 +237,20 @@ class EngineBackend:
     def count_tokens(self, text: str) -> int:
         return self.tokenizer.count(text)
 
+    def host_counters(self) -> Dict[str, float]:
+        """Cumulative host<->device traffic counters of the backing
+        engine (engine.h2d_uploads / d2h_syncs / dispatches /
+        decode_tokens — docs/performance.md).  The serve layer exposes
+        them so bench/ops can compute syncs-per-decoded-token without
+        reaching into engine internals.  With ``host_overlap`` engines
+        note the counters run one flush behind the last committed token
+        (lagged commit); read after drain (``has_work`` False) for exact
+        totals."""
+        counts = getattr(self.engine, "_counts", None) or {}
+        return {key: float(counts.get(key, 0.0))
+                for key in ("engine.h2d_uploads", "engine.d2h_syncs",
+                            "engine.dispatches", "engine.decode_tokens")}
+
 
 class EchoBackend:
     """Deterministic test backend: replies with a fixed or prompt-derived
