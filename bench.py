@@ -471,6 +471,95 @@ def bench_rca_resume(n_runs: int = 8, n_appends: int = 256):
             else None}
 
 
+def bench_cluster(n_runs: int = 12, max_new: int = 32):
+    """Multi-replica cluster leg (k8s_llm_rca_tpu/cluster/): engine
+    replicas on disjoint submeshes behind the affinity router, one fresh
+    interpreter, three measurements:
+
+    - ``dispatch_p50_ms``/``dispatch_p99_ms``: host wall-clock of
+      ``router.start`` (pick + tokenize + engine admission) per run —
+      pure host work, no device dispatch inside the timed call, so the
+      tunnel's dispatch latency and memoization cannot touch it.
+    - ``failover_recovery_s``: wall-clock from ``fail_replica`` on the
+      busiest replica mid-decode until every migrated run settles on the
+      survivors (re-prefill + re-decode included).  Needs >=2 replicas;
+      null on a single-device host (measurement-or-null).
+    - ``tokens_per_s``: aggregate completion tokens over the whole
+      sweep's wall-clock, failover included — sweep-leg methodology
+      (every tick's inputs differ, memoization-immune).
+    """
+    from k8s_llm_rca_tpu.cluster import ClusterRouter, build_replicas
+    from k8s_llm_rca_tpu.serve.backend import GenOptions
+
+    devices = jax.devices()
+    n_replicas = 2 if len(devices) >= 2 else 1
+    use = devices[:(len(devices) // n_replicas) * n_replicas]
+    cfg = TINY.replace(max_seq_len=512)
+    ecfg = EngineConfig(max_batch=4, max_seq_len=512, paged=True,
+                        page_size=16, num_pages=160,
+                        prefill_buckets=(64,), max_new_tokens=max_new,
+                        temperature=0.0, decode_chunk=4,
+                        prefix_cache=False)
+    router = ClusterRouter(build_replicas(cfg, ecfg, n_replicas,
+                                          devices=use))
+
+    rng = np.random.default_rng(29)
+    words = ("pod", "node", "oom", "evicted", "crashloop", "pressure",
+             "namespace", "deployment", "restart", "taint")
+
+    def prompt(i):
+        picks = rng.integers(0, len(words), size=24)
+        return f"incident {i}: " + " ".join(words[int(p)] for p in picks)
+
+    # compile pass: one full generation per replica (sessions pin one run
+    # to each submesh), excluded from every timed region below
+    warm = [router.start(prompt(1000 + r),
+                         GenOptions(session=f"warm_{r}",
+                                    max_new_tokens=max_new))
+            for r in range(n_replicas)]
+    while any(router.busy(h) for h in warm):
+        router.pump()
+
+    results = {}
+    lat_ms = []
+    t_sweep = time.perf_counter()
+    handles = []
+    for i in range(n_runs):
+        p = prompt(i)
+        opts = GenOptions(session=f"th_{i % (2 * n_replicas)}",
+                          max_new_tokens=max_new)
+        t0 = time.perf_counter()
+        handles.append(router.start(p, opts))
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+    failover_s, moved = None, []
+    if n_replicas >= 2:
+        for _ in range(2):                      # runs decoding mid-flight
+            results.update(router.pump())
+        victim = max(router.alive_ids(),
+                     key=lambda r: (router.replicas[r].queue_depth(), r))
+        t0 = time.perf_counter()
+        moved = router.fail_replica(victim)
+        while any(router.busy(g) for g in moved):
+            results.update(router.pump())
+        failover_s = time.perf_counter() - t0
+    while any(router.busy(h) for h in handles):
+        results.update(router.pump())
+    sweep_wall = time.perf_counter() - t_sweep
+
+    tokens = sum(results[h].completion_tokens for h in handles)
+    tps = tokens / sweep_wall if sweep_wall > 0 else None
+    return {"replicas": n_replicas,
+            "dispatch_p50_ms": round(float(np.percentile(lat_ms, 50)), 4),
+            "dispatch_p99_ms": round(float(np.percentile(lat_ms, 99)), 4),
+            "failover_recovery_s": round(failover_s, 4)
+            if failover_s is not None else None,
+            "migrated": len(moved),
+            "tokens_per_s": round(tps, 2) if tps else None,
+            "tokens": int(tokens), "wall_s": round(sweep_wall, 2),
+            "runs": n_runs}
+
+
 def bench_host_overlap(n_prompts: int = 48, max_batch: int = 8,
                        prompt_len: int = 64, max_new: int = 32):
     """Overlapped-hot-loop leg (docs/performance.md): the TINY paged
@@ -631,6 +720,7 @@ def main():
     chaos = _leg("bench.bench_rca_chaos()", timeout=1500) or {}
     obs = _leg("bench.bench_obs()", timeout=1500) or {}
     resume = _leg("bench.bench_rca_resume()", timeout=1500) or {}
+    cluster = _leg("bench.bench_cluster()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -744,6 +834,17 @@ def main():
         "rca_resume_records": resume.get("records"),
         "rca_resume_resubmitted": resume.get("resubmitted"),
         "rca_resume_prefix_hit_ratio": resume.get("prefix_hit_ratio"),
+        # multi-replica cluster (cluster/): router dispatch latency,
+        # failover recovery wall-clock, and aggregate tokens/s across a
+        # mid-decode replica kill, each measured in one fresh
+        # interpreter; null when the leg failed — schema stays stable
+        "cluster_replicas": cluster.get("replicas"),
+        "cluster_router_dispatch_p50_ms": cluster.get("dispatch_p50_ms"),
+        "cluster_router_dispatch_p99_ms": cluster.get("dispatch_p99_ms"),
+        "cluster_failover_recovery_s": cluster.get(
+            "failover_recovery_s"),
+        "cluster_migrated_runs": cluster.get("migrated"),
+        "cluster_tokens_per_s": cluster.get("tokens_per_s"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

@@ -1,0 +1,26 @@
+"""Multi-replica serving cluster: disjoint submesh replicas, an
+affinity/load-balancing router, and journal-consistent failover.
+
+Layer map (PARITY.md §cluster, docs/cluster.md):
+
+- ``submesh.carve_replica_meshes`` — carve the device list into N
+  disjoint dp×tp submeshes (loud ValueError on indivisibility/overlap);
+- ``replica.build_replicas`` / ``Replica`` — one engine per submesh,
+  params initialized once and sharded per replica;
+- ``router.ClusterRouter`` — the LMBackend facade the assistants
+  service talks to: session affinity on thread id, queue-depth
+  balancing, ``RouterAdmissionError`` backpressure, ``fail_replica``
+  (kill + re-start on survivors) and ``drain_replica``
+  (snapshot/adopt migration with decode position).
+"""
+
+from k8s_llm_rca_tpu.cluster.replica import (EngineReplica, Replica,
+                                             build_replicas)
+from k8s_llm_rca_tpu.cluster.router import (ClusterRouter,
+                                            RouterAdmissionError)
+from k8s_llm_rca_tpu.cluster.submesh import carve_replica_meshes
+
+__all__ = [
+    "carve_replica_meshes", "build_replicas", "Replica", "EngineReplica",
+    "ClusterRouter", "RouterAdmissionError",
+]

@@ -1,0 +1,118 @@
+"""One serving replica: an LM backend bound to a replica id (and, for
+engine replicas, its submesh).
+
+``build_replicas`` is the engine path: the TINY weights are initialized
+ONCE on host and sharded onto each replica's submesh
+(runtime/sharding.py ``shard_pytree`` — GSPMD then keeps every replica's
+compute on its own devices, the same committed-input propagation the TP
+parity test relies on), so N replicas cost one param init and N device
+transfers, not N inits.  Each replica gets its own engine, tokenizer
+handle, and ``EngineBackend``; the engine is stamped with
+``obs_replica`` so its ``engine.tick`` spans and TickSamples carry the
+replica id (per-replica Chrome tracks, obs/export.py).
+
+``Replica`` itself is backend-agnostic: the router only needs
+``queue_depth()`` / ``occupancy()``, duck-typed here so scripted
+backends (OracleBackend, EchoBackend — ``_inflight`` dicts) and the real
+``EngineBackend`` (``_live`` + engine slots) all serve as replicas; the
+cluster chaos soak runs 100 incidents on oracle replicas for exactly
+this reason (tier-1 budget).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from k8s_llm_rca_tpu.engine.engine import validate_replica_mesh
+from k8s_llm_rca_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Replica:
+    """A replica slot in the cluster: id, backend, optional submesh."""
+
+    def __init__(self, replica_id: int, backend: Any, mesh=None):
+        self.replica_id = replica_id
+        self.backend = backend
+        self.mesh = mesh
+        self.alive = True
+
+    def queue_depth(self) -> int:
+        b = self.backend
+        if hasattr(b, "queue_depth"):
+            return int(b.queue_depth())
+        if hasattr(b, "_live"):
+            return len(b._live)
+        if hasattr(b, "_inflight"):
+            return len(b._inflight)
+        raise TypeError(
+            f"replica {self.replica_id}: backend "
+            f"{type(b).__name__} exposes no queue-depth signal "
+            f"(queue_depth() / _live / _inflight)")
+
+    def occupancy(self) -> float:
+        b = self.backend
+        if hasattr(b, "occupancy"):
+            return float(b.occupancy())
+        return 0.0
+
+    def __repr__(self) -> str:
+        return (f"Replica({self.replica_id}, "
+                f"{type(self.backend).__name__}, "
+                f"alive={self.alive}, depth={self.queue_depth()})")
+
+
+# kept as an alias for call sites that want to say what the replica IS
+EngineReplica = Replica
+
+
+def build_replicas(model_cfg, engine_cfg, n_replicas: int,
+                   devices: Optional[Sequence[Any]] = None,
+                   data: int = 1, seed: int = 0,
+                   meshes=None, **engine_kw) -> List[Replica]:
+    """N engine replicas on disjoint submeshes, one shared param init.
+
+    ``meshes``: pre-carved submeshes (else ``carve_replica_meshes`` runs
+    with ``devices``/``data``).  Every mesh passes
+    ``validate_replica_mesh`` — CP/PP/EP × replica compositions and
+    submeshes the TINY head layout cannot shard are rejected loudly
+    before any device work.  ``engine_kw`` forwards to ``make_engine``
+    (e.g. ``use_kernel=False`` on the CPU test mesh).
+    """
+    import jax
+
+    from k8s_llm_rca_tpu.cluster.submesh import carve_replica_meshes
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        llama_param_specs, shard_pytree,
+    )
+    from k8s_llm_rca_tpu.serve.backend import EngineBackend
+
+    if meshes is None:
+        meshes = carve_replica_meshes(n_replicas, devices=devices,
+                                      data=data)
+    if len(meshes) != n_replicas:
+        raise ValueError(f"{len(meshes)} meshes for {n_replicas} replicas")
+    for mesh in meshes:
+        validate_replica_mesh(mesh, model_cfg, engine_cfg)
+
+    tok = engine_kw.pop("tokenizer", None)
+    if tok is None:
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        tok = get_tokenizer(vocab_size=model_cfg.vocab_size)
+    params = llama.init_params(model_cfg, jax.random.PRNGKey(seed))
+    specs = llama_param_specs(model_cfg)
+
+    replicas: List[Replica] = []
+    for rid, mesh in enumerate(meshes):
+        sharded = shard_pytree(params, specs, mesh)
+        engine = make_engine(model_cfg, engine_cfg, sharded, tok,
+                             **engine_kw)
+        engine.obs_replica = rid      # per-replica span/TickSample tag
+        replicas.append(Replica(rid, EngineBackend(engine), mesh=mesh))
+    log.info("built %d engine replicas: %s devices each",
+             len(replicas), meshes[0].devices.size if replicas else 0)
+    return replicas

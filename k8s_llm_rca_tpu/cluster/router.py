@@ -1,0 +1,316 @@
+"""Cluster router: session affinity, queue-depth balancing, admission
+backpressure, and journal-consistent failover across replicas.
+
+``ClusterRouter`` IS an ``LMBackend`` (serve/backend.py protocol): the
+assistants service plugs it in where a single EngineBackend would go and
+never learns it is talking to N replicas.  Global handles belong to the
+router; each maps to ``(replica_id, local_handle)`` and the mapping is
+rewritten — never surfaced — when a run migrates.
+
+Routing (``start``):
+
+- **affinity**: ``GenOptions.session`` (the thread id,
+  serve/api.py:create_run) pins a session to one replica while that
+  replica is alive.  A thread's prompt grows monotonically, so keeping
+  its runs on one replica keeps its history in that replica's prefix
+  cache (engine/prefix.py) — affinity is a cache-locality policy, not
+  just stickiness.  A pinned replica at capacity overflows THIS run to
+  the least-loaded replica without re-pinning: the next run returns to
+  the warm replica.
+- **balance**: un-pinned (or overflowed) runs go to the alive replica
+  with the smallest ``queue_depth()``, ties to the lowest replica id —
+  fully deterministic, no randomization (reports must be byte-stable).
+- **backpressure**: when every alive replica is at
+  ``max_inflight_per_replica``, ``start`` raises
+  ``RouterAdmissionError`` instead of queueing unboundedly — the
+  serve-layer caller owns retry/shedding policy, the router only refuses
+  loudly (same philosophy as the engine's loud ValueError exclusions).
+
+Failover (``fail_replica``): process-kill semantics — the replica's
+device state is gone.  Its journaled-in-memory ``(prompt, opts)`` pairs
+(the router records every admitted run; the durable twin lives in the
+run journal, serve/journal.py) are re-started on survivors under the
+SAME global handles, so the serve layer's ``_inflight`` map stays valid
+across the kill and ``recover_service`` replay agrees with the router's
+view.  Greedy decode makes the re-run byte-identical; generated-but-
+unsettled tokens are dropped exactly like a supervised process crash
+(serve/recover.py replay contract).
+
+Migration (``drain_replica``): graceful decommission — the source is
+still alive, so its sequences move WITH their decode position:
+``engine.snapshot_sequences`` on the source, seq-id-remapping
+``EngineBackend.adopt_sequences`` on the target, handle map rewritten in
+place.  The re-prefill on the target is a prefix-cache mostly-HIT when
+the target has seen the session before (tests/test_cluster.py proves
+both the byte-identity and the hit-rate).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+from k8s_llm_rca_tpu.cluster.replica import Replica
+from k8s_llm_rca_tpu.obs import trace as obs_trace
+from k8s_llm_rca_tpu.serve.backend import BackendResult, GenOptions
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+
+class RouterAdmissionError(RuntimeError):
+    """Every alive replica is at its inflight cap — the cluster sheds the
+    request instead of queueing it invisibly.  Retry/backoff belongs to
+    the caller (resilience policy), not the router."""
+
+
+class ClusterRouter:
+    """LMBackend facade over N replicas.  See module docstring."""
+
+    def __init__(self, replicas: List[Replica],
+                 max_inflight_per_replica: Optional[int] = None):
+        if not replicas:
+            raise ValueError("ClusterRouter needs at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {sorted(ids)}")
+        self.replicas: Dict[int, Replica] = {
+            r.replica_id: r for r in sorted(replicas,
+                                            key=lambda r: r.replica_id)}
+        self.max_inflight = max_inflight_per_replica
+        self._handles = itertools.count()
+        # global handle -> (replica_id, local handle); rewritten on
+        # migration, never surfaced to callers
+        self._handle_map: Dict[int, Tuple[int, int]] = {}
+        self._local: Dict[Tuple[int, int], int] = {}   # reverse map
+        # every admitted run's (prompt, opts): the failover re-start
+        # source (in-memory twin of the journaled run_submit record)
+        self._runs: Dict[int, Tuple[str, GenOptions]] = {}
+        self._affinity: Dict[str, int] = {}            # session -> replica
+        self.failovers = 0
+        self.migrated_runs = 0
+
+    # ------------------------------------------------------------ accessors
+
+    def alive_ids(self) -> List[int]:
+        return [rid for rid, r in self.replicas.items() if r.alive]
+
+    def queue_depths(self) -> Dict[int, int]:
+        return {rid: r.queue_depth()
+                for rid, r in self.replicas.items() if r.alive}
+
+    def occupancies(self) -> Dict[int, float]:
+        return {rid: r.occupancy()
+                for rid, r in self.replicas.items() if r.alive}
+
+    # -------------------------------------------------------------- routing
+
+    def _has_capacity(self, replica: Replica) -> bool:
+        return (self.max_inflight is None
+                or replica.queue_depth() < self.max_inflight)
+
+    def _pick(self, session: str, admit: bool = True) -> int:
+        """Deterministic replica choice; raises RouterAdmissionError when
+        the cluster is saturated.  ``admit=False`` is the failover path:
+        the run was ALREADY admitted, so the inflight cap does not apply
+        — a kill must never shed work the cluster accepted."""
+        alive = self.alive_ids()
+        if not alive:
+            raise RouterAdmissionError("no alive replica")
+        if session:
+            pinned = self._affinity.get(session)
+            if pinned is not None and not self.replicas[pinned].alive:
+                pinned = None               # re-pin below
+            if pinned is not None and (not admit or self._has_capacity(
+                    self.replicas[pinned])):
+                return pinned
+        open_ = [rid for rid in alive
+                 if not admit or self._has_capacity(self.replicas[rid])]
+        if not open_:
+            raise RouterAdmissionError(
+                f"all {len(alive)} alive replicas at inflight cap "
+                f"{self.max_inflight}; shedding request")
+        rid = min(open_, key=lambda r: (self.replicas[r].queue_depth(), r))
+        if session and self._affinity.get(session) not in alive:
+            self._affinity[session] = rid   # (re-)pin; overflow keeps pin
+        return rid
+
+    # ------------------------------------------------------------- protocol
+
+    def start(self, prompt: str, opts: GenOptions) -> int:
+        rid = self._pick(opts.session)
+        replica = self.replicas[rid]
+        lhandle = replica.backend.start(prompt, opts)
+        ghandle = next(self._handles)
+        self._handle_map[ghandle] = (rid, lhandle)
+        self._local[(rid, lhandle)] = ghandle
+        self._runs[ghandle] = (prompt, opts)
+        obs_trace.event("cluster.route", replica=rid,
+                        session=opts.session,
+                        depth=replica.queue_depth())
+        METRICS.inc("cluster.dispatches")
+        return ghandle
+
+    def pump(self) -> Dict[int, BackendResult]:
+        results: Dict[int, BackendResult] = {}
+        for rid, replica in self.replicas.items():
+            if not replica.alive:
+                continue
+            # mirror the router's view into the replica engine before its
+            # tick, so this tick's TickSample carries this tick's load
+            engine = getattr(replica.backend, "engine", None)
+            if engine is not None:
+                engine._cluster_gauges = {
+                    "queue_depth": float(replica.queue_depth()),
+                    "occupancy": float(replica.occupancy()),
+                }
+            for lhandle, res in replica.backend.pump().items():
+                ghandle = self._local.pop((rid, lhandle), None)
+                if ghandle is None:        # settled after cancel: drop
+                    continue
+                self._handle_map.pop(ghandle, None)
+                self._runs.pop(ghandle, None)
+                results[ghandle] = res
+        return results
+
+    def busy(self, handle: int) -> bool:
+        return handle in self._handle_map
+
+    def cancel(self, handle: int) -> None:
+        loc = self._handle_map.pop(handle, None)
+        self._runs.pop(handle, None)
+        if loc is None:
+            return
+        self._local.pop(loc, None)
+        rid, lhandle = loc
+        self.replicas[rid].backend.cancel(lhandle)
+
+    def count_tokens(self, text: str) -> int:
+        first = next(iter(self.replicas.values()))
+        return first.backend.count_tokens(text)
+
+    def host_counters(self) -> Dict[str, float]:
+        """Sum of the alive replicas' engine host counters (the cluster's
+        aggregate host<->device traffic, serve/backend.py contract)."""
+        total: Dict[str, float] = {}
+        for r in self.replicas.values():
+            if not r.alive or not hasattr(r.backend, "host_counters"):
+                continue
+            for k, v in r.backend.host_counters().items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+    # ------------------------------------------------------------- failover
+
+    def _orphans(self, rid: int) -> List[int]:
+        """Global handles currently assigned to ``rid``, in admission
+        order (global handles are monotonic)."""
+        return sorted(g for g, (r, _) in self._handle_map.items()
+                      if r == rid)
+
+    def fail_replica(self, rid: int) -> List[int]:
+        """Hard-kill ``rid`` and re-start its in-flight runs on
+        survivors under their existing global handles.  Returns the
+        migrated global handles.  Refuses to kill the last alive
+        replica."""
+        replica = self.replicas.get(rid)
+        if replica is None or not replica.alive:
+            raise ValueError(f"replica {rid} is not alive")
+        if len(self.alive_ids()) <= 1:
+            raise ValueError(
+                f"refusing to fail replica {rid}: it is the last alive "
+                f"replica (an outage, not a failover)")
+        replica.alive = False
+        orphans = self._orphans(rid)
+        # reap the dead replica's engine state (the engine OBJECT stands
+        # in for the dead worker; cancelling releases its slots/pages)
+        for ghandle in orphans:
+            _, lhandle = self._handle_map[ghandle]
+            self._local.pop((rid, lhandle), None)
+            replica.backend.cancel(lhandle)
+        # drop dead pins; _pick re-pins each session on its next touch
+        for session in [s for s, r in self._affinity.items() if r == rid]:
+            del self._affinity[session]
+        for ghandle in orphans:
+            prompt, opts = self._runs[ghandle]
+            new_rid = self._pick(opts.session, admit=False)
+            new_lhandle = self.replicas[new_rid].backend.start(prompt,
+                                                               opts)
+            self._handle_map[ghandle] = (new_rid, new_lhandle)
+            self._local[(new_rid, new_lhandle)] = ghandle
+        self.failovers += 1
+        METRICS.inc("cluster.failovers")
+        obs_trace.event("cluster.failover", replica=rid, kind="kill",
+                        migrated=len(orphans),
+                        alive=len(self.alive_ids()))
+        log.warning("replica %d failed: %d runs re-started on survivors "
+                    "(%d alive)", rid, len(orphans),
+                    len(self.alive_ids()))
+        return orphans
+
+    def drain_replica(self, rid: int,
+                      target: Optional[int] = None) -> List[int]:
+        """Gracefully decommission ``rid``: migrate its sequences — WITH
+        their decode position — onto ``target`` (default: least-loaded
+        survivor) via snapshot/adopt, then take it out of rotation.
+        Returns the migrated global handles."""
+        replica = self.replicas.get(rid)
+        if replica is None or not replica.alive:
+            raise ValueError(f"replica {rid} is not alive")
+        alive = [r for r in self.alive_ids() if r != rid]
+        if not alive:
+            raise ValueError(
+                f"refusing to drain replica {rid}: no surviving replica "
+                f"to migrate onto")
+        if target is None:
+            target = min(alive,
+                         key=lambda r: (self.replicas[r].queue_depth(), r))
+        if target == rid or target not in alive:
+            raise ValueError(f"drain target {target} must be a DIFFERENT "
+                             f"alive replica (alive: {alive})")
+        src, dst = replica.backend, self.replicas[target].backend
+        engine = getattr(src, "engine", None)
+        if engine is None or not hasattr(dst, "adopt_sequences"):
+            raise ValueError(
+                "drain_replica needs engine replicas on both sides "
+                "(snapshot_sequences/adopt_sequences); for scripted "
+                "replicas use fail_replica (re-start semantics)")
+        snap = engine.snapshot_sequences()
+        seqs = list(snap.get("sequences", []))
+        # snapshot order -> source local handles, global handles, opts
+        src_lhandles = [src._seq_to_handle[s["seq_id"]] for s in seqs]
+        ghandles = [self._local[(rid, lh)] for lh in src_lhandles]
+        opts_list = [self._runs[g][1] for g in ghandles]
+        new_lhandles = dst.adopt_sequences(snap, opts_list)
+        replica.alive = False
+        # runs with no engine sequence (injected-failed/stalled) cannot
+        # be snapshotted; they fail over by re-start, like a kill
+        leftovers = [g for g in self._orphans(rid) if g not in ghandles]
+        # the source's sequences moved; retire them there so the drained
+        # engine ends clean (pages freed through the normal cancel path)
+        for ghandle, lhandle in zip(ghandles, src_lhandles):
+            self._local.pop((rid, lhandle), None)
+            src.cancel(lhandle)
+        for ghandle, new_lhandle in zip(ghandles, new_lhandles):
+            self._handle_map[ghandle] = (target, new_lhandle)
+            self._local[(target, new_lhandle)] = ghandle
+        for ghandle in leftovers:
+            _, lhandle = self._handle_map[ghandle]
+            self._local.pop((rid, lhandle), None)
+            src.cancel(lhandle)
+            prompt, opts = self._runs[ghandle]
+            new_rid = min(alive,
+                          key=lambda r: (self.replicas[r].queue_depth(),
+                                         r))
+            nl = self.replicas[new_rid].backend.start(prompt, opts)
+            self._handle_map[ghandle] = (new_rid, nl)
+            self._local[(new_rid, nl)] = ghandle
+        for session in [s for s, r in self._affinity.items() if r == rid]:
+            self._affinity[session] = target   # follow the sequences
+        self.migrated_runs += len(ghandles)
+        METRICS.inc("cluster.migrated_runs", len(ghandles))
+        obs_trace.event("cluster.failover", replica=rid, kind="drain",
+                        migrated=len(ghandles), target=target)
+        log.info("replica %d drained: %d sequences adopted by replica %d",
+                 rid, len(ghandles), target)
+        return ghandles

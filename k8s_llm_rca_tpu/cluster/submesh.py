@@ -1,0 +1,60 @@
+"""Carving disjoint replica submeshes from the global device list.
+
+The reference serves every request through one OpenAI deployment; the
+multi-replica equivalent here carves the devices JAX enumerates into N
+contiguous groups and builds one dp×tp mesh per group — the same
+world-size → dp×mp factoring shape as the mesh helpers surveyed in
+SNIPPETS.md [3] (``get_mesh``), specialized to replicas: the slowest
+"axis" is the replica index itself (no collectives cross it), and each
+group keeps its devices adjacent so the per-replica TP collectives stay
+on ICI neighbors exactly like a single-engine mesh would
+(runtime/mesh.py device-order note).
+
+Compositions that would need collectives to span replicas (CP, PP, EP)
+are rejected loudly by ``engine.validate_replica_mesh``; device overlap
+between replicas is rejected by ``engine.validate_disjoint_submeshes``.
+On the 8-virtual-device CPU test mesh the supported configurations are
+2 replicas × tp4 and 4 replicas × tp2 (each with an exact greedy-parity
+test against the plain single-engine path, per repo convention).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from k8s_llm_rca_tpu.config import MeshConfig
+from k8s_llm_rca_tpu.engine.engine import validate_disjoint_submeshes
+from k8s_llm_rca_tpu.runtime.mesh import build_mesh
+
+
+def carve_replica_meshes(n_replicas: int,
+                         devices: Optional[Sequence[jax.Device]] = None,
+                         data: int = 1) -> List[Mesh]:
+    """Split the device list into ``n_replicas`` contiguous groups and
+    build one dp×tp mesh per group.
+
+    ``data``: DP width inside each replica (default 1 — replicas ARE the
+    data parallelism); the model axis takes the rest of the group.
+    Raises loudly when the device count does not divide.
+    """
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % n_replicas:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_replicas} "
+            f"replica submeshes; pick a replica count dividing the "
+            f"device count")
+    per = len(devices) // n_replicas
+    if per % data:
+        raise ValueError(
+            f"replica submesh of {per} devices does not carry a data "
+            f"axis of {data}")
+    cfg = MeshConfig(data=data, model=per // data)
+    meshes = [build_mesh(cfg, devices=devices[i * per:(i + 1) * per])
+              for i in range(n_replicas)]
+    validate_disjoint_submeshes(meshes)
+    return meshes

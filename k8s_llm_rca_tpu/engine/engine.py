@@ -153,6 +153,46 @@ def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh=None,
             f"{tp_mesh.shape['data']}")
 
 
+def validate_replica_mesh(mesh, model_cfg, engine_cfg) -> None:
+    """Cluster-replica preconditions (cluster/submesh.py): a replica
+    submesh is a plain dp×tp carve of the global device list.  The
+    replica axis already multiplies throughput by running N independent
+    engines, so any composition whose collectives would have to span
+    replicas — CP sequence sharding, PP stages, EP dispatch — is excluded
+    loudly at construction rather than silently computing on a submesh
+    that cannot see the other replicas' devices."""
+    if mesh is None:
+        return
+    for axis, what in (("seq", "CP"), ("stage", "PP"), ("expert", "EP")):
+        if mesh.shape.get(axis, 1) > 1:
+            raise ValueError(
+                f"{what}×replica is unsupported: a cluster replica owns a "
+                f"DISJOINT submesh and its collectives cannot span "
+                f"replicas (axis '{axis}'={mesh.shape[axis]}); replica "
+                f"submeshes carve dp×tp only (cluster/submesh.py) — run "
+                f"{what} inside ONE engine on the full mesh instead")
+    validate_tp_mesh(mesh, model_cfg, engine_cfg)
+
+
+def validate_disjoint_submeshes(meshes) -> None:
+    """Replica submeshes must not share a single device: two engines
+    dispatching onto one chip would serialize (and on TPU fight over the
+    chip grant), silently destroying the throughput the cluster layer
+    exists to multiply.  Loud ValueError names the overlapping device."""
+    seen: Dict[int, int] = {}
+    for i, mesh in enumerate(meshes):
+        if mesh is None:
+            continue
+        for d in mesh.devices.flat:
+            if d.id in seen:
+                raise ValueError(
+                    f"replica submeshes overlap: device {d.id} belongs to "
+                    f"both replica {seen[d.id]} and replica {i}; carve "
+                    f"disjoint contiguous device groups "
+                    f"(cluster.carve_replica_meshes)")
+            seen[d.id] = i
+
+
 def validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh,
                      cp_seq_axis: str = "seq") -> None:
     """EP serving preconditions: MoE model; mesh carries "data" and
@@ -716,6 +756,17 @@ class EngineBase:
     # even when METRICS carries other engines'/tests' history
     _counts: Optional[Dict[str, float]] = None
 
+    # cluster attribution (cluster/replica.py): the replica id this engine
+    # serves under, None outside a cluster.  When set, engine.tick spans
+    # carry a ``replica`` arg and TickSample.engine_id routes the Chrome
+    # counter tracks onto a per-replica tid — attribution rides existing
+    # span names, so the SITES registry stays closed.
+    obs_replica: Optional[int] = None
+    # router-written gauges (cluster/router.py writes queue_depth /
+    # occupancy before pumping this replica); mirrored into TickSample so
+    # the router's view rides the same per-tick recorder as pool pressure
+    _cluster_gauges: Optional[Dict[str, float]] = None
+
     def _count(self, name: str, value: float = 1.0) -> None:
         """Increment a counter in METRICS and this engine's private
         mirror (both cheap; the mirror is a plain dict add)."""
@@ -898,7 +949,9 @@ class EngineBase:
         tr = obs_trace._ACTIVE
         if tr is None:                         # untraced cost: this check
             return self._tick()
-        with tr.span("engine.tick", cat="engine"):
+        targs = ({} if self.obs_replica is None
+                 else {"replica": self.obs_replica})
+        with tr.span("engine.tick", cat="engine", **targs):
             finished = self._tick()
         self._record_tick(tr)
         return finished
@@ -932,7 +985,12 @@ class EngineBase:
                                        0.0),
             h2d_uploads=c.get("engine.h2d_uploads", 0.0),
             d2h_syncs=c.get("engine.d2h_syncs", 0.0),
-            dispatches=c.get("engine.dispatches", 0.0)))
+            dispatches=c.get("engine.dispatches", 0.0),
+            engine_id=self.obs_replica or 0,
+            cluster_queue_depth=(self._cluster_gauges or {}).get(
+                "queue_depth", 0.0),
+            cluster_occupancy=(self._cluster_gauges or {}).get(
+                "occupancy", 0.0)))
 
     # ---------------------------------------- chunked scan tick (shared)
 

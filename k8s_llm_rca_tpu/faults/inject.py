@@ -27,7 +27,12 @@ Sites in the real stack:
   (serve/recover.py).  Polled from the supervisor's OWN plan at
   incident boundaries, never from the armed chaos plan, so a crash
   cannot perturb the armed plan's poll counters (the soak's
-  byte-identity proof depends on that).
+  byte-identity proof depends on that);
+- ``SITE_REPLICA`` (``faults/supervisor.py::ReplicaKiller``): cluster
+  replica "crash" — one replica dies and the router fails its in-flight
+  runs over onto survivors (cluster/router.py).  Same discipline as
+  SITE_PROCESS: polled from the killer's OWN plan at incident
+  boundaries, never from the armed chaos plan.
 """
 
 from __future__ import annotations
@@ -41,6 +46,7 @@ SITE_GRAPH = "graph.query"
 SITE_BACKEND = "backend.start"
 SITE_ENGINE_TICK = "engine.tick"
 SITE_PROCESS = "serve.process"
+SITE_REPLICA = "cluster.replica"
 
 # the armed plan; hot paths read this directly (see module docstring)
 _ARMED: Optional[FaultPlan] = None

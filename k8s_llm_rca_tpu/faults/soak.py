@@ -95,19 +95,78 @@ def _build_oracle_service(run_timeout_s: float, clock, journal=None):
                             clock=clock, journal=journal), None, factory
 
 
+def _build_cluster_service(run_timeout_s: float, clock, journal=None,
+                           n_replicas: int = 2, oracle: bool = False):
+    """N-replica serving behind a ClusterRouter (cluster/).  ``oracle``
+    replicas are scripted backends — the cheap mode the 100-incident
+    replica-kill soak runs on (tier-1 budget); engine replicas reuse the
+    single-engine soak's TINY config, sharded onto disjoint submeshes.
+
+    Returns ``(service, engines, factory, router)`` — ``engines`` is the
+    per-replica engine list ([] for oracle replicas) so the caller can
+    assert EVERY replica ends clean, and ``factory`` returns the SAME
+    router (replica engines stand in for restarted workers, exactly like
+    the single-engine soak's factory)."""
+    from k8s_llm_rca_tpu.cluster import ClusterRouter, Replica
+    from k8s_llm_rca_tpu.serve.api import AssistantService
+
+    if oracle:
+        from k8s_llm_rca_tpu.rca.oracle import OracleBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        tok = get_tokenizer()
+        replicas = [Replica(i, OracleBackend(tok))
+                    for i in range(n_replicas)]
+        engines = []
+    else:
+        from k8s_llm_rca_tpu.cluster import build_replicas
+        from k8s_llm_rca_tpu.config import TINY, EngineConfig
+
+        cfg = TINY.replace(max_seq_len=2560)
+        replicas = build_replicas(
+            cfg,
+            EngineConfig(max_batch=4, max_seq_len=2560,
+                         prefill_buckets=(2560,),
+                         max_new_tokens=96, temperature=0.0,
+                         paged=True, page_size=64, num_pages=168,
+                         prefix_cache=False, decode_chunk=16),
+            n_replicas, seed=0, use_kernel=False)
+        engines = [r.backend.engine for r in replicas]
+    router = ClusterRouter(replicas)
+    factory = lambda: router                           # noqa: E731
+    return (AssistantService(router, run_timeout_s=run_timeout_s,
+                             clock=clock, journal=journal),
+            engines, factory, router)
+
+
 def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    backend: str = "engine",
                    plan_spec: Optional[Dict[str, Any]] = None,
                    run_timeout_s: float = 1.5,
                    tracer: Optional[Any] = None,
                    durable_dir: Optional[str] = None,
-                   supervisor: Optional[Any] = None) -> Dict[str, Any]:
+                   supervisor: Optional[Any] = None,
+                   cluster_replicas: int = 2,
+                   killer: Optional[Any] = None) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
 
     ``backend``: "engine" (the real paged TINY engine — tick faults and
     stalls bite) or "oracle" (scripted backend — graph faults only; the
-    cheap mode bench.py publishes alongside the engine soak).
+    cheap mode bench.py publishes alongside the engine soak), or their
+    multi-replica forms "cluster" / "cluster-oracle" — ``cluster_replicas``
+    engines (or scripted oracles) on disjoint submeshes behind a
+    ClusterRouter (cluster/router.py).
+
+    ``killer``: optional faults.supervisor.ReplicaKiller (cluster modes
+    only) polled once at every incident boundary on its OWN FaultPlan;
+    on a scheduled "crash" one replica dies and the router fails its
+    work over to survivors.  Like the supervisor, kill stats live on
+    the killer object, never in the report — the kill-soak report must
+    stay byte-identical to the unkilled run's (use a plan_spec without
+    SITE_ENGINE_TICK for engine clusters: per-tick polls shift with the
+    survivor's extra ticks, which is fault-schedule divergence, not
+    nondeterminism).
 
     ``tracer``: optional obs.Tracer — activated for the whole soak with
     its clock REBOUND to the soak's VirtualClock, so every span/event
@@ -157,12 +216,26 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                          "journal is the only recovery source a crash "
                          "leaves behind")
 
+    router = None
     if backend == "engine":
         service, engine, factory = _build_engine_service(
             run_timeout_s, clock, journal)
+        engines = [engine]
+    elif backend in ("cluster", "cluster-oracle"):
+        service, engines, factory, router = _build_cluster_service(
+            run_timeout_s, clock, journal,
+            n_replicas=cluster_replicas,
+            oracle=(backend == "cluster-oracle"))
+        engine = None   # "engine_clean" is per-replica below
     else:
         service, engine, factory = _build_oracle_service(
             run_timeout_s, clock, journal)
+        engines = []
+    if killer is not None:
+        if router is None:
+            raise ValueError("killer requires a cluster backend: replica "
+                             "kills need a router to fail over through")
+        killer.router = router
     meta = ResilientExecutor(InMemoryGraphExecutor(build_metagraph()),
                              policy, dep="graph.meta")
     state = ResilientExecutor(InMemoryGraphExecutor(build_stategraph()),
@@ -203,6 +276,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                     # function of (plan, n_incidents)
                     service = supervisor.checkpoint(
                         pipeline, service, factory, run_timeout_s, clock)
+                if killer is not None:
+                    killer.checkpoint()
                 continue
             degraded = result.get("degraded", [])
             row["status"] = "degraded" if degraded else "resolved"
@@ -226,6 +301,11 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                 # service replaces ours (pipeline rebound inside)
                 service = supervisor.checkpoint(
                     pipeline, service, factory, run_timeout_s, clock)
+            if killer is not None:
+                # same discipline, replica granularity: exactly one poll
+                # per incident on both outcome paths (the killer's own
+                # plan; the router fails the victim over in place)
+                killer.checkpoint()
 
     if journal is not None:
         # close the CURRENT journal (a supervised crash may have swapped
@@ -250,16 +330,23 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     }
     if tracer is not None:
         report["flight"] = tracer.flight_summary()
-    if engine is not None:
-        # the chaos run must leave the engine clean: drained, allocator
-        # invariants intact, no leaked pages beyond prefix-cache residency
-        engine.allocator.check()
-        resident = (engine.prefix_cache.n_resident
-                    if engine.prefix_cache else 0)
-        report["engine_clean"] = bool(
-            not engine.has_work
-            and engine.allocator.n_free + resident
-            == engine.engine_cfg.num_pages - 1)
+    if engines:
+        # the chaos run must leave EVERY engine clean — killed replicas
+        # included (failover cancels through the normal retire path, so a
+        # leaked page on a dead replica is a failover bug): drained,
+        # allocator invariants intact, no pages beyond prefix residency
+        clean = True
+        for eng in engines:
+            eng.allocator.check()
+            resident = (eng.prefix_cache.n_resident
+                        if eng.prefix_cache else 0)
+            clean = clean and bool(
+                not eng.has_work
+                and eng.allocator.n_free + resident
+                == eng.engine_cfg.num_pages - 1)
+        report["engine_clean"] = clean
+    if router is not None:
+        report["cluster_replicas"] = cluster_replicas
     return report
 
 

@@ -126,3 +126,60 @@ class CrashSupervisor:
                     "%d runs resubmitted", self.crashes, report["records"],
                     len(report["resubmitted"]))
         return svc
+
+
+class ReplicaKiller:
+    """Deterministic replica-kill harness for cluster soaks
+    (``run_chaos_soak(backend="cluster*", killer=...)``).
+
+    Same two disciplines as ``CrashSupervisor``, for the same reasons:
+    it polls ``inject.SITE_REPLICA`` on its OWN FaultPlan (never the
+    armed chaos plan — a kill must not shift the armed plan's poll
+    counters, or the killed run's report would diverge from the
+    unkilled run and the byte-identity proof would compare different
+    fault histories), and it is polled exactly once per incident
+    boundary on both outcome paths, so its kill schedule is a pure
+    function of (plan, n_incidents).
+
+    On a scheduled "crash" fault it hard-kills one alive replica through
+    ``router.fail_replica`` — process-kill semantics: the replica's
+    device KV is treated as gone and its in-flight runs re-start from
+    their recorded prompts on survivors (greedy decode makes the final
+    outputs identical).  The victim is chosen deterministically from the
+    alive list by the fault's poll index.  The last alive replica is
+    never killed (the router would refuse loudly; a cluster soak is a
+    failover proof, not an outage proof).
+
+    ``router`` may be bound after construction (``killer.router = r``) —
+    ``run_chaos_soak`` builds the router itself and binds the killer to
+    it before the sweep starts.
+    """
+
+    def __init__(self, plan: FaultPlan, router=None):
+        self.plan = plan
+        self.router = router
+        self.kills: List[int] = []
+
+    def checkpoint(self) -> Optional[int]:
+        """Incident-boundary poll: kills one replica on a scheduled
+        "crash"; returns the victim's replica id, else None."""
+        fault = self.plan.poll(inject.SITE_REPLICA)
+        if fault is None or self.router is None:
+            return None
+        if fault.kind != "crash":
+            log.warning("replica fault %r ignored: only 'crash' is "
+                        "meaningful at %s", fault.kind,
+                        inject.SITE_REPLICA)
+            return None
+        alive = self.router.alive_ids()
+        if len(alive) <= 1:
+            log.warning("replica kill skipped: %d replica(s) alive",
+                        len(alive))
+            return None
+        victim = alive[fault.index % len(alive)]
+        self.router.fail_replica(victim)
+        self.kills.append(victim)
+        METRICS.inc("faults.replica_kills")
+        log.warning("replica kill #%d: replica %d failed over (%d alive)",
+                    len(self.kills), victim, len(self.router.alive_ids()))
+        return victim

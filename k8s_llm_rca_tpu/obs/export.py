@@ -79,7 +79,10 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
         })
     if keep is None:
         for s in tracer.timeline.samples():
-            base = {"ph": "C", "ts": _us(s.ts), "pid": 1, "tid": 0}
+            # tid = replica id (0 outside a cluster): per-replica counter
+            # tracks separate in Perfetto instead of interleaving
+            base = {"ph": "C", "ts": _us(s.ts), "pid": 1,
+                    "tid": s.engine_id}
             events.append({**base, "name": "engine.seqs",
                            "args": {"running": s.running,
                                     "queued": s.queued}})
@@ -99,7 +102,11 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
             events.append({**base, "name": "engine.host",
                            "args": {"h2d_uploads": s.h2d_uploads,
                                     "d2h_syncs": s.d2h_syncs,
-                                    "dispatches": s.dispatches}})
+                                    "dispatches": s.dispatches,
+                                    "cluster_queue_depth":
+                                    s.cluster_queue_depth,
+                                    "cluster_occupancy":
+                                    s.cluster_occupancy}})
     # stable sort: equal-ts events keep recording order, so the document
     # is a pure function of the recording (byte-identity under VirtualClock)
     events.sort(key=lambda e: e["ts"])
@@ -192,12 +199,16 @@ class _Family:
              f"# TYPE {self.name} {self.kind}"] + self.samples)
 
 
-def prometheus_text(metrics=None, engine=None) -> str:
+def prometheus_text(metrics=None, engine=None, router=None) -> str:
     """Render the Metrics store (+ optional live engine gauges) as
     Prometheus text exposition.  Counters -> ``<name>_total`` counter
     families; phase timers -> summary families (p50 over the retained
     reservoir window, exact _sum/_count); engine -> scheduler/pool gauges
-    (running/queued seqs, free/evictable pages, prefix-hit tokens)."""
+    (running/queued seqs, free/evictable pages, prefix-hit tokens);
+    router (cluster.ClusterRouter) -> ``cluster_*`` gauges: replicas
+    alive plus per-replica queue depth / occupancy with a ``replica``
+    label (the ``cluster.*`` counters — dispatches, failovers, migrated
+    runs — already ride the Metrics store as ``_total`` families)."""
     if metrics is None:
         from k8s_llm_rca_tpu.utils.logging import METRICS as metrics
 
@@ -244,6 +255,22 @@ def prometheus_text(metrics=None, engine=None) -> str:
         for key in sorted(gauges):
             family(f"{_PREFIX}{key}", "gauge",
                    f"live engine gauge {key!r}").add(gauges[key])
+
+    if router is not None:
+        family(f"{_PREFIX}cluster_replicas_alive", "gauge",
+               "cluster replicas currently serving").add(
+            len(router.alive_ids()))
+        depths = router.queue_depths()
+        occs = router.occupancies()
+        fam_q = family(f"{_PREFIX}cluster_replica_queue_depth", "gauge",
+                       "live runs routed onto each replica")
+        for rid in sorted(depths):
+            fam_q.add(depths[rid], labels=f'{{replica="{rid}"}}')
+        fam_o = family(f"{_PREFIX}cluster_replica_occupancy", "gauge",
+                       "fraction of engine batch slots occupied per "
+                       "replica")
+        for rid in sorted(occs):
+            fam_o.add(occs[rid], labels=f'{{replica="{rid}"}}')
 
     return "\n".join(families[n].render()
                      for n in sorted(families)) + "\n"

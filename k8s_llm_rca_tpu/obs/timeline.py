@@ -42,6 +42,14 @@ class TickSample:
     h2d_uploads: float = 0.0
     d2h_syncs: float = 0.0
     dispatches: float = 0.0
+    # cluster attribution (cluster/): which replica's engine recorded
+    # this sample (0 outside a cluster — also the Chrome counter-track
+    # tid, so per-replica tracks separate in Perfetto), plus the
+    # router's view of that replica at its last dispatch: live runs
+    # queued on it and the fraction of batch slots occupied
+    engine_id: int = 0
+    cluster_queue_depth: float = 0.0
+    cluster_occupancy: float = 0.0
 
 
 class TickTimeline:

@@ -65,6 +65,9 @@ SITES = frozenset({
     # durability layer (serve/journal.py, serve/recover.py)
     "serve.journal.append",
     "serve.recover.replay",
+    # cluster layer (cluster/router.py)
+    "cluster.route",
+    "cluster.failover",
     # graph layer
     "graph.query",
     # rca pipeline stages

@@ -242,8 +242,11 @@ class AssistantService:
         self._thread_runs[thread_id].append(run.id)
 
         prompt = render_prompt(assistant, self.threads[thread_id], instructions)
+        # session = thread id: the cluster router's affinity key, so every
+        # run of a thread lands on the replica already holding its prefix
         opts = dataclasses.replace(gen or assistant.gen,
-                                   assistant_name=assistant.name)
+                                   assistant_name=assistant.name,
+                                   session=thread_id)
         run.usage["prompt_tokens"] = self.backend.count_tokens(prompt)
         run.backend_handle = self.backend.start(prompt, opts)
         run.status = RunStatus.IN_PROGRESS
@@ -364,11 +367,17 @@ class AssistantService:
         live engine gauges (running/queued seqs, free/evictable pages,
         prefix-hit tokens) when the backend carries an engine.  This is
         the serve API's scrape surface — an HTTP wrapper only needs to
-        return this string with content type text/plain; version=0.0.4."""
+        return this string with content type text/plain; version=0.0.4.
+        A cluster backend (cluster.ClusterRouter — duck-typed on its
+        ``queue_depths`` accessor) additionally yields ``cluster_*``
+        gauges: replicas alive, per-replica queue depth and occupancy."""
         from k8s_llm_rca_tpu.obs.export import prometheus_text
 
+        router = (self.backend
+                  if hasattr(self.backend, "queue_depths") else None)
         return prometheus_text(METRICS,
-                               engine=getattr(self.backend, "engine", None))
+                               engine=getattr(self.backend, "engine", None),
+                               router=router)
 
     # ------------------------------------------------------------ execution
 

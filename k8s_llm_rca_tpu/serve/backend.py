@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Protocol, Set, Tuple
+from typing import Dict, List, Optional, Protocol, Sequence, Set, Tuple
 
 from k8s_llm_rca_tpu.engine.constrain import make_grammar
 from k8s_llm_rca_tpu.engine.engine import InferenceEngine
@@ -45,6 +45,13 @@ class GenOptions:
     # the scripted oracle routes on it (prompt-substring routing is brittle
     # to harmless rewordings and kept only as its fallback).
     assistant_name: str = ""
+    # cluster routing metadata: the session key (the thread id) the run
+    # belongs to, populated by AssistantService.create_run.  Single-engine
+    # backends ignore it; the cluster router pins a session to one replica
+    # (cluster/router.py affinity) so a thread's monotonically growing
+    # prompt keeps hitting the replica whose prefix cache already holds
+    # its history.
+    session: str = ""
 
 
 class BudgetError(ValueError):
@@ -236,6 +243,75 @@ class EngineBackend:
 
     def count_tokens(self, text: str) -> int:
         return self.tokenizer.count(text)
+
+    def queue_depth(self) -> int:
+        """Live runs on this backend — the router's load-balancing
+        signal (cluster/router.py picks the alive replica with the
+        smallest depth for a session it has not seen)."""
+        return len(self._live)
+
+    def occupancy(self) -> float:
+        """Fraction of the engine's batch slots occupied (0..1) — the
+        per-replica gauge the router mirrors into the tick timeline and
+        Prometheus ``cluster_replica_occupancy``."""
+        return (len(self.engine._active)
+                / max(1, self.engine.engine_cfg.max_batch))
+
+    def adopt_sequences(self, snap: Dict[str, object],
+                        opts: Sequence[GenOptions]) -> List[int]:
+        """Adopt another engine's ``snapshot_sequences`` export into THIS
+        backend: the cluster failover path (cluster/router.py
+        ``drain_replica``).  Three things make adoption different from a
+        raw ``restore_sequences`` on the target engine:
+
+        - seq ids are REMAPPED into the target engine's namespace (the
+          replicas' independent ``_seq_counter``s collide, and
+          ``restore_sequences`` raises loudly on collision by design);
+        - grammars are recompiled from each run's GenOptions SPEC and
+          rebuilt by advancing over the generated tokens (compiled FSMs
+          are host objects owned by the dead replica);
+        - the source RNG key is dropped (``rng_key: None``): migration
+          must never clobber the target replica's key mid-decode —
+          greedy parity holds regardless, by the snapshot contract.
+
+        Fresh backend handles are registered per sequence so ``pump``
+        settles the migrated runs exactly like native ones (results for
+        unknown seq_ids are dropped there — adoption must come through
+        here, never through the engine directly).  Returns the new
+        handles in snapshot order."""
+        seqs = list(snap.get("sequences", []))
+        if len(opts) != len(seqs):
+            raise ValueError(
+                f"adopt_sequences needs one GenOptions per snapshotted "
+                f"sequence: got {len(opts)} for {len(seqs)}")
+        remapped = []
+        grammars: Dict[int, object] = {}
+        for s, o in zip(seqs, opts):
+            new_id = next(self.engine._seq_counter)
+            s2 = dict(s)
+            s2["seq_id"] = new_id
+            if s.get("grammar"):
+                if o.grammar is None:
+                    raise ValueError(
+                        f"seq {s['seq_id']} was grammar-constrained but "
+                        f"its GenOptions carries no grammar spec; the "
+                        f"FSM is rebuilt from the spec at adoption")
+                grammars[new_id] = make_grammar(
+                    o.grammar, self.tokenizer,
+                    prefer_native=self.engine.engine_cfg.native)
+            remapped.append(s2)
+        self.engine.restore_sequences(
+            {"rng_key": None, "sequences": remapped}, grammars=grammars)
+        handles: List[int] = []
+        for s2, o in zip(remapped, opts):
+            handle = next(self._handles)
+            seq_id = s2["seq_id"]
+            self._seq_to_handle[seq_id] = handle
+            self._handle_seq[handle] = seq_id
+            self._opts[handle] = o
+            self._live[handle] = True
+            handles.append(handle)
+        return handles
 
     def host_counters(self) -> Dict[str, float]:
         """Cumulative host<->device traffic counters of the backing

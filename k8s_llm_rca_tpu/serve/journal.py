@@ -65,7 +65,8 @@ def encode_gen(gen) -> Optional[Dict[str, Any]]:
             "the spec to GenOptions and let the backend compile it")
     return {"max_new_tokens": gen.max_new_tokens, "stop": list(gen.stop),
             "forced_prefix": gen.forced_prefix, "suffix": gen.suffix,
-            "grammar": grammar, "assistant_name": gen.assistant_name}
+            "grammar": grammar, "assistant_name": gen.assistant_name,
+            "session": gen.session}
 
 
 def decode_gen(d: Optional[Dict[str, Any]]):
@@ -77,7 +78,8 @@ def decode_gen(d: Optional[Dict[str, Any]]):
     return GenOptions(
         max_new_tokens=int(d["max_new_tokens"]), stop=tuple(d["stop"]),
         forced_prefix=d["forced_prefix"], suffix=d["suffix"],
-        grammar=grammar, assistant_name=d.get("assistant_name", ""))
+        grammar=grammar, assistant_name=d.get("assistant_name", ""),
+        session=d.get("session", ""))   # pre-cluster journals lack it
 
 
 class RunJournal:

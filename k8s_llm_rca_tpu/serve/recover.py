@@ -154,9 +154,13 @@ def recover_service(journal_path: str, backend, run_timeout_s: float = 600.0,
             for run_id, rec in interrupted.items():
                 run = svc.runs[run_id]
                 assistant = svc.assistants[run.assistant_id]
+                # session = thread id, re-stamped exactly as create_run
+                # does: a cluster router recovering the journal re-pins
+                # the thread's affinity instead of scattering its runs
                 opts = dataclasses.replace(
                     decode_gen(rec["gen"]) or assistant.gen,
-                    assistant_name=assistant.name)
+                    assistant_name=assistant.name,
+                    session=rec["thread_id"])
                 prompt = rec["prompt"]
                 run.usage["prompt_tokens"] = backend.count_tokens(prompt)
                 run.t_started = now()

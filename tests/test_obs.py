@@ -286,6 +286,46 @@ class TestPrometheus:
         assert "k8s_llm_rca_engine_running_seqs" in text
         assert "k8s_llm_rca_engine_free_pages" in text
 
+    def test_cluster_router_gauges(self):
+        """Router-aware exposition: per-replica queue depth / occupancy
+        as labelled gauges plus the alive-replica count (satellite 2 of
+        the cluster subsystem)."""
+        from k8s_llm_rca_tpu.cluster import ClusterRouter, Replica
+        from k8s_llm_rca_tpu.serve.backend import EchoBackend, GenOptions
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        tok = get_tokenizer()
+        router = ClusterRouter([
+            Replica(0, EchoBackend(tok, delay_pumps=10 ** 9)),
+            Replica(1, EchoBackend(tok, delay_pumps=10 ** 9))])
+        router.start("p", GenOptions())
+        text = prometheus_text(Metrics(), router=router)
+        assert "k8s_llm_rca_cluster_replicas_alive 2" in text
+        assert ('k8s_llm_rca_cluster_replica_queue_depth'
+                '{replica="0"} 1') in text
+        assert ('k8s_llm_rca_cluster_replica_queue_depth'
+                '{replica="1"} 0') in text
+        assert 'k8s_llm_rca_cluster_replica_occupancy{replica="0"}' in text
+        assert "# TYPE k8s_llm_rca_cluster_replicas_alive gauge" in text
+        router.fail_replica(0)
+        text = prometheus_text(Metrics(), router=router)
+        assert "k8s_llm_rca_cluster_replicas_alive 1" in text
+        assert '{replica="0"}' not in text    # dead replicas drop out
+
+    def test_serve_api_cluster_router_rendering(self):
+        """AssistantService.prometheus_metrics detects a router backend
+        and renders the cluster families."""
+        from k8s_llm_rca_tpu.cluster import ClusterRouter, Replica
+        from k8s_llm_rca_tpu.serve.api import AssistantService
+        from k8s_llm_rca_tpu.serve.backend import EchoBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        tok = get_tokenizer()
+        service = AssistantService(ClusterRouter(
+            [Replica(0, EchoBackend(tok)), Replica(1, EchoBackend(tok))]))
+        text = service.prometheus_metrics()
+        assert "k8s_llm_rca_cluster_replicas_alive 2" in text
+
 
 # ---------------------------------------------------------------------------
 # golden byte-identity: traced seeded chaos soak (acceptance bar)
@@ -340,6 +380,32 @@ class TestTracedSoak:
                          if e["ph"] == "C"}
         assert {"engine.seqs", "engine.pages",
                 "engine.tokens", "engine.sched"} <= counter_names
+
+    def test_cluster_counter_tracks_separate_by_replica(self):
+        """TickSamples stamped with engine_id render onto per-replica
+        Chrome counter tracks (tid = replica id) and the engine.host
+        track carries the router's queue-depth/occupancy gauges
+        (satellite 2 of the cluster subsystem)."""
+        from k8s_llm_rca_tpu.obs.timeline import TickSample
+
+        tr = Tracer(clock=VirtualClock())
+        tr.timeline.record(TickSample(
+            tick=0, ts=0.001, running=1, queued=0, engine_id=0,
+            cluster_queue_depth=2.0, cluster_occupancy=0.5))
+        tr.timeline.record(TickSample(
+            tick=0, ts=0.002, running=1, queued=1, engine_id=1,
+            cluster_queue_depth=1.0, cluster_occupancy=0.25))
+        doc = chrome_trace(tr)
+        validate_chrome_trace(doc)
+        host = sorted((e for e in doc["traceEvents"]
+                       if e["name"] == "engine.host"),
+                      key=lambda e: e["ts"])
+        assert [e["tid"] for e in host] == [0, 1]   # separate tracks
+        assert host[0]["args"]["cluster_queue_depth"] == 2.0
+        assert host[1]["args"]["cluster_occupancy"] == 0.25
+        # every counter event of one sample rides that sample's track
+        assert {e["tid"] for e in doc["traceEvents"]
+                if e["ph"] == "C" and e["ts"] == host[1]["ts"]} == {1}
 
 
 # ---------------------------------------------------------------------------
@@ -410,12 +476,27 @@ class TestSiteCoverage:
                 ("fallback", lambda: 42),
             ]) == 42
 
+        # (4) cluster sites: route one run through a 2-replica echo
+        # cluster, then fail a replica over (cluster/router.py)
+        from k8s_llm_rca_tpu.cluster import ClusterRouter, Replica
+        from k8s_llm_rca_tpu.serve.backend import EchoBackend
+
+        tr_cluster = Tracer(clock=VirtualClock())
+        tracers.append(tr_cluster)
+        with obs_trace.tracing(tr_cluster):
+            router = ClusterRouter([
+                Replica(0, EchoBackend(tok, delay_pumps=10 ** 9)),
+                Replica(1, EchoBackend(tok))])
+            h = router.start("node notready", GenOptions(session="t"))
+            router.fail_replica(router._handle_map[h][0])
+            assert h in router.pump()
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:
         # anything we emit under a known prefix must be registered
         prefixes = ("engine.", "serve.", "backend.", "graph.", "rca.",
-                    "resilience.")
+                    "resilience.", "cluster.")
         emitted = set()
         for tr in tracers:
             emitted |= tr.emitted_names()
