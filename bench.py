@@ -61,7 +61,8 @@ def bench_engine_model(model_key: str, max_batch: int, max_seq_len: int,
                        page_size: int, num_pages: int, n_prompts: int,
                        prompt_len: int, max_new: int,
                        decode_chunk: int = 32, use_kernel=None,
-                       kv_dtype: "str | None" = "int4"):
+                       kv_dtype: "str | None" = "int4",
+                       fused: bool = False):
     """Measured tokens/sec of a REAL model through the paged
     continuous-batching engine (int4 weights + int4 KV, the flagship
     quant config; the Pallas paged-attention kernel on the decode path).
@@ -85,7 +86,8 @@ def bench_engine_model(model_key: str, max_batch: int, max_seq_len: int,
     from k8s_llm_rca_tpu.runtime import profiling
     from k8s_llm_rca_tpu.utils.logging import METRICS
 
-    cfg = MODEL_REGISTRY[model_key].replace(max_seq_len=max_seq_len)
+    cfg = MODEL_REGISTRY[model_key].replace(max_seq_len=max_seq_len,
+                                            fused_quant_matmul=fused)
     params = llama.init_params(
         cfg, jax.random.PRNGKey(0),
         tensor_transform=quantizing_transform(bits=4))
@@ -161,6 +163,66 @@ def bench_8b_leg():
     return bench_engine_model(
         "llama3-8b", max_batch=144, max_seq_len=768, page_size=64,
         num_pages=1864, n_prompts=288, prompt_len=512, max_new=128)
+
+
+def bench_kernel_leg():
+    """Fused weight-dequant matmul kernel leg (ops/quant_matmul.py,
+    ISSUE 7): the 8B-int4 paged engine with
+    ``ModelConfig.fused_quant_matmul`` off (the dq()-then-matmul XLA
+    path) then on (Pallas kernels streaming packed int4 tiles), over
+    identical workloads with the sweep-leg methodology — committed
+    decode tokens over host wall-clock across hundreds of
+    data-dependent ticks, so the tunnel's memoization and dispatch
+    latency cannot fake a speedup.  ``speedup`` is a ratio of two such
+    measurements (exact); the bytes-per-token pair quantifies WHY the
+    kernel should win — the minimum HBM traffic with packed int4
+    weights streamed in-register vs the dq() path's materialized
+    compute-dtype copy — and lives in analytic (``roofline_``-prefixed)
+    fields, never measured ones.
+
+    Capability-gated: the kernels only lower on a real TPU backend, and
+    this host's Pallas/TPU toolchain may predate what they need (the
+    interpret-mode parity suite tests/test_quant_matmul.py is the
+    correctness evidence either way).  The probe runs ONE tiny
+    quant_matmul through the actual TPU lowering first; if it fails,
+    every kernel_* field publishes null (measurement-or-null) with the
+    probe error preserved."""
+    from k8s_llm_rca_tpu.config import MODEL_REGISTRY as _REG
+    from k8s_llm_rca_tpu.models.quant import dq, quantize
+    from k8s_llm_rca_tpu.ops.quant_matmul import quant_matmul
+    from k8s_llm_rca_tpu.runtime import profiling
+
+    if jax.default_backend() != "tpu":
+        return {"supported": False, "error": "backend is not tpu"}
+    try:
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 256))
+        w = quantize(jax.random.normal(jax.random.PRNGKey(1), (256, 512)),
+                     axis=-1, compute_dtype=np.float32, bits=4)
+        got = np.asarray(quant_matmul(x, w, interpret=False))
+        np.testing.assert_allclose(got, np.asarray(x @ dq(w)),
+                                   rtol=2e-2, atol=2e-2)
+    except Exception as e:            # lowering/runtime capability gap
+        return {"supported": False, "error": str(e)[:300]}
+
+    plain = bench_engine_model(
+        "llama3-8b", max_batch=144, max_seq_len=768, page_size=64,
+        num_pages=1864, n_prompts=144, prompt_len=512, max_new=128)
+    fused = bench_engine_model(
+        "llama3-8b", max_batch=144, max_seq_len=768, page_size=64,
+        num_pages=1864, n_prompts=144, prompt_len=512, max_new=128,
+        fused=True)
+
+    cfg = _REG["llama3-8b"]
+    ctx = 512 + 128 // 2
+    bpt_packed = profiling.decode_bytes_per_token(
+        cfg, ctx, 144, weight_bits=4, kv_bits=4)
+    # the dq() path materializes weights at compute dtype before the
+    # GEMM reads them — weight traffic at 16 bits, same KV
+    bpt_dq = profiling.decode_bytes_per_token(
+        cfg, ctx, 144, weight_bits=16, kv_bits=4)
+    return {"supported": True, "plain": plain, "fused": fused,
+            "bytes_per_token_packed": round(bpt_packed, 1),
+            "bytes_per_token_dq": round(bpt_dq, 1)}
 
 
 def bench_rca_p50(n_incidents: int = 100):
@@ -704,10 +766,11 @@ def main():
     platform, device_str = probe
     on_tpu = platform == "tpu"
 
-    eng_1b = eng_8b = None
+    eng_1b = eng_8b = kern = None
     if on_tpu:
         eng_1b = _leg("bench.bench_tinyllama_leg()", timeout=1500)
         eng_8b = _leg("bench.bench_8b_leg()", timeout=1800)
+        kern = _leg("bench.bench_kernel_leg()", timeout=3600)
     p50_oracle = _leg("bench.bench_rca_p50()")
     sweep = _leg("bench.bench_rca_p50_engine()", timeout=1800)
     (p50_engine, n_engine, n_workers, eng_tps, eng_mfu, eng_tokens,
@@ -747,6 +810,20 @@ def main():
     f_1b, ok_1b, tps_1b = leg_fields(eng_1b, "engine_tinyllama_int4")
     sweep_ok = credible(eng_tps, eng_mfu, None)
 
+    # fused weight-dequant kernel leg (ops/quant_matmul.py): two
+    # measured engine runs (dq baseline + fused) when the kernels
+    # actually lower on this host's TPU toolchain; EVERY kernel_* field
+    # otherwise null — the shims' CPU/interpret fallbacks are
+    # byte-identical dq() expressions, so a non-TPU "speedup" would
+    # measure nothing (measurement-or-null)
+    kern_sup = bool(kern) and kern.get("supported")
+    f_kf, ok_kf, tps_kf = leg_fields(
+        kern.get("fused") if kern_sup else None, "kernel_fused_8b_int4")
+    f_kp, ok_kp, tps_kp = leg_fields(
+        kern.get("plain") if kern_sup else None, "kernel_plain_8b_int4")
+    kernel_speedup = (round(tps_kf / tps_kp, 4)
+                      if ok_kf and ok_kp and tps_kp else None)
+
     # headline: best credible flagship-scale measurement, labeled with
     # ITS OWN leg's self-description (VERDICT r4 weak #1: the metadata
     # must describe value_source's leg, never another leg's)
@@ -779,6 +856,18 @@ def main():
         "batch": batch,
         **f_8b,
         **f_1b,
+        **f_kf,
+        **f_kp,
+        # fused/plain is a ratio of two credible measurements (exact);
+        # the bytes-per-token pair is the ANALYTIC model of what packed
+        # int4 streaming saves vs the dq() materialized copy, so it
+        # lives under the roofline_ prefix like every non-measurement
+        "kernel_speedup": kernel_speedup,
+        "kernel_supported": kern_sup if kern is not None else None,
+        "roofline_kernel_hbm_bytes_per_token_packed":
+        kern.get("bytes_per_token_packed") if kern_sup else None,
+        "roofline_kernel_hbm_bytes_per_token_dq":
+        kern.get("bytes_per_token_dq") if kern_sup else None,
         # TINY RCA engine sweep: measured tok/s gated like every leg
         "engine_measured_tokens_per_s": eng_tps if sweep_ok else None,
         # the sweep's MFU cross-check is computed from an ASSUMED mean

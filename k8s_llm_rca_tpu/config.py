@@ -34,6 +34,13 @@ class ModelConfig:
     # MoE (0 experts == dense Llama MLP)
     n_experts: int = 0
     n_experts_per_tok: int = 2
+    # route every weight-dequant GEMM (wq/wk/wv/wo, MLP, lm head, stacked
+    # experts) through the fused Pallas kernels (ops/quant_matmul.py) that
+    # stream PACKED int8/int4 tiles and dequantize in-register — on a real
+    # TPU backend with quantized unsharded-or-shard-local weights; every
+    # other case (plain arrays, CPU/interpret hosts, GSPMD-sharded
+    # consumption) falls back to the identical x @ dq(w) XLA path
+    fused_quant_matmul: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -221,6 +228,16 @@ class EngineConfig:
     # Greedy byte-parity with host_overlap=False is guaranteed for every
     # supported composition; cp_mesh is excluded (loud ValueError).
     host_overlap: bool = False
+    # per-tick prefill token budget (paged engine only; 0 = off): a
+    # prompt whose post-prefix-hit suffix exceeds the budget admits
+    # through the existing jitted chunk-prefill path spread across ticks
+    # — one <=budget page-aligned chunk per tick, the sequence's own
+    # already-written pages as the growing prefix — instead of stalling
+    # one tick on a monolithic prefill.  Must be a page_size multiple
+    # (chunks scatter whole pages); greedy byte-parity with budget=0 is
+    # guaranteed; cp_mesh/pp_mesh and the contiguous engine are excluded
+    # (loud ValueErrors).
+    prefill_chunk_budget: int = 0
 
 
 @dataclass(frozen=True)

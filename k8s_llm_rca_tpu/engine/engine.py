@@ -986,6 +986,7 @@ class EngineBase:
             h2d_uploads=c.get("engine.h2d_uploads", 0.0),
             d2h_syncs=c.get("engine.d2h_syncs", 0.0),
             dispatches=c.get("engine.dispatches", 0.0),
+            prefill_chunks=c.get("engine.prefill_chunks", 0.0),
             engine_id=self.obs_replica or 0,
             cluster_queue_depth=(self._cluster_gauges or {}).get(
                 "queue_depth", 0.0),
@@ -1485,6 +1486,13 @@ class InferenceEngine(EngineBase):
                 "host_np collectives must line up SPMD-identically across "
                 "processes — a lagged commit would reorder them.  Run CP "
                 "engines with host_overlap=False")
+        if engine_cfg.prefill_chunk_budget:
+            raise ValueError(
+                "prefill_chunk_budget is a paged-engine feature: the "
+                "contiguous cache has no chunked prefix-prefill path to "
+                "spread a prompt across ticks (its prefill writes one "
+                "monolithic slot slice).  Use paged=True "
+                "(PagedInferenceEngine) or prefill_chunk_budget=0")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],

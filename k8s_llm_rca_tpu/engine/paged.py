@@ -479,13 +479,14 @@ def _chunk_layer(cfg: ModelConfig, layer, x, angles, positions, mask,
     attn = _chunk_attention(cfg, q,
                             jnp.concatenate([kp, k], axis=1),
                             jnp.concatenate([vp, v], axis=1), mask)
-    out = attn.reshape(b, c_pad, -1) @ dq(layer["wo"])
+    out = llama._w_mm(cfg, attn.reshape(b, c_pad, -1), layer["wo"])
     if tp_axis is not None:
         x = x + jax.lax.psum(out, tp_axis)
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu(hm @ dq(layer["w_gate"]))
-        up = hm @ dq(layer["w_up"])
-        x = x + jax.lax.psum((gate * up) @ dq(layer["w_down"]), tp_axis)
+        gate = jax.nn.silu(llama._w_mm(cfg, hm, layer["w_gate"]))
+        up = llama._w_mm(cfg, hm, layer["w_up"])
+        x = x + jax.lax.psum(llama._w_mm(cfg, gate * up, layer["w_down"]),
+                             tp_axis)
     else:
         x = x + out
         hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
@@ -965,6 +966,28 @@ class PagedInferenceEngine(EngineBase):
                 "host_np collectives must line up SPMD-identically across "
                 "processes — a lagged commit would reorder them; serve CP "
                 "engines with host_overlap=False")
+        pcb = engine_cfg.prefill_chunk_budget
+        if pcb:
+            if pcb < 0 or pcb % engine_cfg.page_size:
+                raise ValueError(
+                    f"prefill_chunk_budget={pcb} must be a positive "
+                    f"multiple of page_size={engine_cfg.page_size}: each "
+                    f"per-tick chunk scatters whole pages, so its growing "
+                    f"prefix stays page-aligned for the next chunk's "
+                    f"gather")
+            if cp_mesh is not None:
+                raise ValueError(
+                    "prefill_chunk_budget is unsupported with cp_mesh "
+                    "(the chunk-prefill path is not context-parallel; CP "
+                    "prefills whole sequences through prefill_kv_cp)")
+            if pp_mesh is not None:
+                raise ValueError(
+                    "prefill_chunk_budget is unsupported with pp_mesh: "
+                    "the pipelined chunk prefill serves whole prefix-hit "
+                    "admissions within one tick; spreading one admission "
+                    "across ticks would interleave its stage schedule "
+                    "with the GPipe decode microbatches — serve PP "
+                    "engines with prefill_chunk_budget=0")
         self._cp_parts = 0
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
@@ -1147,6 +1170,13 @@ class PagedInferenceEngine(EngineBase):
         self._free_slots = list(range(b))
         self._active: Dict[int, _Active] = {}
         self._pending: List[_Pending] = []
+        # slot -> in-progress chunked-prefill state (prefill_chunk_budget):
+        # the request, its full page table (held OUT of block_tables until
+        # activation — an inactive slot's block-table row must stay
+        # TRASH_PAGE so decode garbage writes are contained), the acquired
+        # cached-prefix pages, the freshly allocated pages, and the
+        # tokens-written watermark
+        self._prefilling: Dict[int, Dict[str, object]] = {}
         self._seq_counter = itertools.count()
         self._prompts: Dict[int, List[int]] = {}   # seq_id -> ORIGINAL prompt
         self._resumed: Dict[int, List[int]] = {}   # seq_id -> pre-preemption
@@ -1475,6 +1505,11 @@ class PagedInferenceEngine(EngineBase):
             # a sync path (grammar, speculation, scan) runs this tick:
             # commit the lag first so it observes fully committed state
             finished.extend(self._overlap_flush())
+        if self._prefilling:
+            # advance every in-progress chunked prefill by ONE chunk
+            # BEFORE admission: budget-limited sequences make progress
+            # each tick even while new admissions compete for pages
+            finished.extend(self._tick_prefill_chunks())
         if self._pending and self._free_slots:
             with profiling.annotate("engine.tick.admission"):
                 finished.extend(self._tick_admission())
@@ -1573,7 +1608,22 @@ class PagedInferenceEngine(EngineBase):
         """Admit pending requests into free slots (the tick's admission
         phase, annotated for XProf/flight records)."""
         finished: List[SequenceResult] = []
+        budget = self.engine_cfg.prefill_chunk_budget
         while self._pending and self._free_slots:
+            if budget and len(self._pending[0].prompt_ids) > budget:
+                # long prompt: admit through the chunked-prefill path —
+                # the first chunk dispatches now, the rest spread one per
+                # tick (_tick_prefill_chunks) instead of stalling this
+                # tick on a monolithic prefill
+                try:
+                    early = self._admit_chunked(self._pending[0])
+                except OutOfPages:
+                    self._count("engine.admission_rejections")
+                    break
+                del self._pending[:1]
+                if early is not None:
+                    finished.append(early)
+                continue
             group, matches = self._admission_group()
             try:
                 # PP has no single-sequence FULL prefill: admissions go
@@ -1974,6 +2024,176 @@ class PagedInferenceEngine(EngineBase):
         self._dev_edit_token(slot, first[0])
         self._defer_first(st, first, 0)
         return None
+
+    def _admit_chunked(self, req: _Pending) -> Optional[SequenceResult]:
+        """Admit a long prompt through the chunk-prefill path spread
+        across ticks (``EngineConfig.prefill_chunk_budget``).
+
+        All pages allocate UP FRONT (all-or-nothing, like _admit: a
+        sequence that may stall mid-prefill waiting for pages would hold
+        its written chunks' pages while blocking the pool — the same
+        livelock admission's no-preemption rule exists to prevent), but
+        the prefill work itself spreads over ticks: one <=budget chunk
+        per tick through the SAME jitted ``_prefill_chunk`` the prefix-
+        cache hit path compiles, each chunk's pages becoming the next
+        chunk's gathered prefix.  Byte-parity with the monolithic path
+        holds because chunked attention over (written prefix + chunk) is
+        exactly the prefix-hit computation the engine already trusts.
+
+        A prompt whose post-prefix-hit SUFFIX fits the budget admits
+        normally — the cache already did the spreading."""
+        matched = (self.prefix_cache.match(req.prompt_ids)
+                   if self.prefix_cache is not None else ([], 0))
+        cached_pages, n_cached = matched
+        rest = req.prompt_ids[n_cached:]
+        if len(rest) <= self.engine_cfg.prefill_chunk_budget:
+            return self._admit(req, matched)
+        n_cp = len(cached_pages)
+        bucket = min(self._bucket(len(rest)),
+                     (self.pages_per_seq - n_cp) * self.page_size)
+        n_pages = bucket // self.page_size
+        try:
+            pages = self._alloc_seq_pages(range(n_cp, n_cp + n_pages),
+                                          owner=req.seq_id)
+        except OutOfPages:
+            if cached_pages:
+                self.prefix_cache.release(cached_pages)
+            raise
+        slot = self._free_slots.pop(0)
+        # the full table lives in _prefilling, NOT block_tables: the slot
+        # stays inactive (row TRASH_PAGE) until the final chunk activates
+        # it, so interleaved decode ticks' garbage writes for this slot
+        # cannot land in the chunk pages being filled
+        table = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
+        table[:n_cp] = cached_pages
+        table[n_cp:n_cp + n_pages] = pages
+        self._prefilling[slot] = {
+            "req": req, "table": table, "n_cp": n_cp,
+            "cached": [int(p) for p in cached_pages],
+            "pages": [int(p) for p in pages],
+            "done": n_cached, "total": len(req.prompt_ids),
+        }
+        if n_cached:
+            self._count("engine.prefix_hit_tokens", n_cached)
+        return self._advance_prefill(slot)   # first chunk dispatches NOW
+
+    def _advance_prefill(self, slot: int) -> Optional[SequenceResult]:
+        """Dispatch ONE chunk of a slot's in-progress chunked prefill;
+        on the final chunk, sample the first token and activate."""
+        st = self._prefilling[slot]
+        req, table = st["req"], st["table"]
+        budget = self.engine_cfg.prefill_chunk_budget
+        done, total = st["done"], st["total"]
+        chunk_len = min(budget, total - done)
+        ps = self.page_size
+        # ``done`` is page-aligned here: it starts at the (whole-page)
+        # cached-prefix length and every non-final chunk advances it by
+        # the page-multiple budget
+        n_pre_pages = done // ps
+        pb = 1
+        while pb < n_pre_pages:
+            pb *= 2
+        prefix_table = np.full((pb,), TRASH_PAGE, np.int32)
+        prefix_table[:n_pre_pages] = table[:n_pre_pages]
+        # fixed [1, budget] compile shape for every chunk; the final
+        # (short) chunk right-pads and maps only its valid pages — the
+        # padding positions scatter to TRASH_PAGE, the engine's standing
+        # garbage-containment convention
+        padded = np.zeros((1, budget), np.int32)
+        padded[0, :chunk_len] = req.prompt_ids[done:done + chunk_len]
+        page_map = np.full((budget // ps,), TRASH_PAGE, np.int32)
+        n_chunk_pages = -(-chunk_len // ps)
+        page_map[:n_chunk_pages] = table[n_pre_pages:
+                                         n_pre_pages + n_chunk_pages]
+        with profiling.annotate("engine.tick.prefill_chunk"):
+            self._count("engine.dispatches")
+            self._count("engine.prefill_chunks")
+            self.pool, logits = self._prefill_chunk(
+                self.model_cfg, self.params, self.pool,
+                jnp.asarray(padded), jnp.int32(chunk_len),
+                jnp.int32(done), jnp.asarray(prefix_table),
+                jnp.asarray(page_map))
+        self._count("engine.prefill_tokens", chunk_len)
+        st["done"] = done + chunk_len
+        if st["done"] < total:
+            return None
+        # final chunk: its last-valid-token logits are the whole prompt's
+        # — sample the first token with exactly the monolithic _admit's
+        # single RNG split, publish the table, activate
+        del self._prefilling[slot]
+        self.block_tables[slot] = table
+        self._key, sub = jax.random.split(self._key)
+        first = self._sample(logits, sub, self.sampling)
+        if req.grammar is not None:
+            return self._activate_paged(req, slot, table, st["n_cp"],
+                                        logits,
+                                        int(self._fetch(first)[0][0]))
+        act = self._preactivate_paged(req, slot, table, st["n_cp"])
+        self._dev_edit_token(slot, first[0])
+        self._defer_first(act, first, 0)
+        return None
+
+    def _tick_prefill_chunks(self) -> List[SequenceResult]:
+        """The tick's chunked-prefill phase: every in-progress slot
+        advances by one chunk."""
+        finished: List[SequenceResult] = []
+        for slot in sorted(self._prefilling):
+            early = self._advance_prefill(slot)
+            if early is not None:
+                finished.append(early)
+        return finished
+
+    def _abort_prefilling(self, slot: int) -> None:
+        """Cancel an in-progress chunked prefill: drop the cached-prefix
+        refcounts, free the allocated pages, return the slot."""
+        st = self._prefilling.pop(slot)
+        seq_id = st["req"].seq_id
+        if st["cached"]:
+            self.prefix_cache.release(st["cached"])
+        if st["pages"]:
+            self.allocator.free(st["pages"], owner=seq_id)
+        self.block_tables[slot] = TRASH_PAGE
+        self._dev_edit_bt_row(slot)
+        self._free_slots.append(slot)
+        self._prompts.pop(seq_id, None)
+        self._resumed.pop(seq_id, None)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active or self._pending or self._prefilling)
+
+    def cancel_seq(self, seq_id: int) -> bool:
+        for slot, st in list(self._prefilling.items()):
+            if st["req"].seq_id == seq_id:
+                self._abort_prefilling(slot)
+                return True
+        return super().cancel_seq(seq_id)
+
+    def snapshot_sequences(self) -> Dict[str, object]:
+        """Chunked-prefill-aware snapshot: a mid-prefill sequence exports
+        as a pending-style entry (original prompt, nothing generated) —
+        its written pages are device state a restart cannot reuse, so
+        restore re-admits it through a fresh prefill, between the active
+        sequences and the pending queue (its scheduler position)."""
+        snap = super().snapshot_sequences()
+        if not self._prefilling:
+            return snap
+        pre = []
+        for slot in sorted(self._prefilling):
+            req = self._prefilling[slot]["req"]
+            pre.append({
+                "seq_id": req.seq_id,
+                "prompt_ids": list(self._prompts.get(req.seq_id,
+                                                     req.prompt_ids)),
+                "generated": list(self._resumed.get(req.seq_id, ())),
+                "remaining_new_tokens": req.max_new_tokens,
+                "stop_strings": list(req.stop_strings),
+                "grammar": req.grammar is not None,
+            })
+        seqs = snap["sequences"]
+        n_active = len(self._active)
+        snap["sequences"] = seqs[:n_active] + pre + seqs[n_active:]
+        return snap
 
     def _preactivate_paged(self, req: _Pending, slot: int, table,
                            n_cp: int) -> _Active:

@@ -33,6 +33,7 @@ from k8s_llm_rca_tpu.ops.attention import (
     causal_attention, decode_attention, decode_attention_multi,
 )
 from k8s_llm_rca_tpu.ops.norms import rms_norm
+from k8s_llm_rca_tpu.ops.quant_matmul import qmm, qmm_experts, qmm_head
 from k8s_llm_rca_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = Dict[str, Any]
@@ -197,6 +198,18 @@ def _kv_packed(cfg: ModelConfig, cache: KVCache) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _w_mm(cfg: ModelConfig, x: jnp.ndarray, w) -> jnp.ndarray:
+    """Every weight-matmul site funnels through here so
+    ``cfg.fused_quant_matmul`` can swap the ``x @ dq(w)`` XLA expression
+    for the fused Pallas kernel shim (ops/quant_matmul.qmm) in ONE
+    place.  The shim's own fallback IS ``x @ dq(w)``, so the flag is
+    numerically inert everywhere the kernel can't run (plain weights,
+    non-TPU backends, GSPMD-sharded params)."""
+    if cfg.fused_quant_matmul:
+        return qmm(x, w)
+    return x @ dq(w)
+
+
 def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
          angles: jnp.ndarray, positions: jnp.ndarray):
     """x [B, S, H] -> q [B, S, n_heads, d], k/v [B, S, n_kv, d] (roped q,k).
@@ -205,9 +218,9 @@ def _qkv(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
     same code serves manual-TP shard bodies whose local weights carry
     n_heads/t heads (parallel/pipeline PP×TP)."""
     b, s, _ = x.shape
-    q = (x @ dq(layer["wq"])).reshape(b, s, -1, cfg.head_dim)
-    k = (x @ dq(layer["wk"])).reshape(b, s, -1, cfg.head_dim)
-    v = (x @ dq(layer["wv"])).reshape(b, s, -1, cfg.head_dim)
+    q = _w_mm(cfg, x, layer["wq"]).reshape(b, s, -1, cfg.head_dim)
+    k = _w_mm(cfg, x, layer["wk"]).reshape(b, s, -1, cfg.head_dim)
+    v = _w_mm(cfg, x, layer["wv"]).reshape(b, s, -1, cfg.head_dim)
     q = apply_rope(q, angles, positions)
     k = apply_rope(k, angles, positions)
     return q, k, v
@@ -234,9 +247,9 @@ def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
                 capacity_factor=float(cfg.n_experts),
                 data_axis=ep_token_axis)
         return _moe_mlp(cfg, layer, x)
-    gate = jax.nn.silu(x @ dq(layer["w_gate"]))
-    up = x @ dq(layer["w_up"])
-    return (gate * up) @ dq(layer["w_down"])
+    gate = jax.nn.silu(_w_mm(cfg, x, layer["w_gate"]))
+    up = _w_mm(cfg, x, layer["w_up"])
+    return _w_mm(cfg, gate * up, layer["w_down"])
 
 
 def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -250,16 +263,22 @@ def _moe_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
     """
     b, s, h = x.shape
     e, k = cfg.n_experts, cfg.n_experts_per_tok
-    router_logits = (x @ dq(layer["router"])).astype(jnp.float32)   # [B,S,E]
+    router_logits = _w_mm(cfg, x, layer["router"]).astype(jnp.float32)  # [B,S,E]
     topv, topi = jax.lax.top_k(router_logits, k)                   # [B,S,k]
     weights = jax.nn.softmax(topv, axis=-1)                        # [B,S,k]
     # scatter the top-k weights back to a dense [B,S,E] map
     onehot = jax.nn.one_hot(topi, e, dtype=jnp.float32)            # [B,S,k,E]
     dense_w = jnp.einsum("bske,bsk->bse", onehot, weights)         # [B,S,E]
 
-    gate = jax.nn.silu(jnp.einsum("bsh,ehi->bsei", x, dq(layer["w_gate"])))
-    up = jnp.einsum("bsh,ehi->bsei", x, dq(layer["w_up"]))
-    per_expert = jnp.einsum("bsei,eih->bseh", gate * up, dq(layer["w_down"]))
+    if cfg.fused_quant_matmul:
+        gate = jax.nn.silu(qmm_experts(x, layer["w_gate"]))
+        up = qmm_experts(x, layer["w_up"])
+        per_expert = qmm_experts(gate * up, layer["w_down"])
+    else:
+        gate = jax.nn.silu(jnp.einsum("bsh,ehi->bsei", x, dq(layer["w_gate"])))
+        up = jnp.einsum("bsh,ehi->bsei", x, dq(layer["w_up"]))
+        per_expert = jnp.einsum("bsei,eih->bseh", gate * up,
+                                dq(layer["w_down"]))
     return jnp.einsum("bseh,bse->bsh", per_expert,
                       dense_w.astype(x.dtype))
 
@@ -299,7 +318,7 @@ def _block_prefill(cfg, layer, x, angles, positions, seq_lens,
     else:
         attn = attention_fn(q, k, v)
     b, s, _, _ = attn.shape
-    x = x + attn.reshape(b, s, cfg.q_dim) @ dq(layer["wo"])
+    x = x + _w_mm(cfg, attn.reshape(b, s, cfg.q_dim), layer["wo"])
     x = _sp_constrain(x, sp_mesh)
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     x = x + _mlp(cfg, layer, h, ep_mesh, ep_token_axis)
@@ -321,7 +340,7 @@ def _decode_finish(cfg: ModelConfig, layer: Params, x: jnp.ndarray,
     MLP (shared across decode paths, see _decode_qkv).  ``attn`` must
     already be flattened to [B, T, q_dim] — kernel outputs vary in rank,
     so call sites own the reshape."""
-    x = x + attn @ dq(layer["wo"])
+    x = x + _w_mm(cfg, attn, layer["wo"])
     hm = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     return x + _mlp(cfg, layer, hm, ep_mesh)
 
@@ -391,6 +410,8 @@ def _write_prefill_kv(cfg: ModelConfig, cache: KVCache, new_k, new_v,
 def _logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
+    if cfg.fused_quant_matmul:
+        return qmm_head(x, head).astype(jnp.float32)
     return jnp.einsum("bsh,vh->bsv", x, dq(head)).astype(jnp.float32)
 
 

@@ -71,6 +71,35 @@ class QuantTensor4(NamedTuple):
         return self.q.ndim
 
 
+class QuantTensor4Grouped(NamedTuple):
+    """A ``repack_nibbles_grouped`` result: nibble-packed int4 whose packed
+    axis is split-half WITHIN each contiguous column group, not globally.
+
+    The distinct type IS the loud-failure guard (ISSUE 7 satellite): the
+    grouped layout is only correct to consume SHARD-LOCALLY (inside a
+    shard_map whose spec splits the packed axis into exactly ``groups``
+    parts), so a *global* ``dq()``/``gather_rows`` on one raises a
+    ValueError instead of silently interleaving columns wrongly.  Shard-
+    local consumers unwrap to a plain ``QuantTensor4`` at the shard_map
+    boundary (parallel/pipeline._stage_local_params), where each shard's
+    block is a self-contained split-half buffer.
+
+    Same two array fields as ``QuantTensor4`` so pytree flatten/unflatten,
+    ``shard_pytree`` placement and the ``type(v)(q=..., scale=...)`` spec
+    construction in pipeline._stacked_in_specs all keep working."""
+
+    q: jnp.ndarray        # int8, grouped split-half packing, last dim halved
+    scale: jnp.ndarray    # compute dtype, 1s except the channel axes
+
+    @property
+    def shape(self):
+        return (*self.q.shape[:-1], self.q.shape[-1] * 2)
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
 def _pack_nibbles(q: jnp.ndarray) -> jnp.ndarray:
     """int8 values in [-8, 7], even last dim -> packed int8, last dim / 2.
 
@@ -120,7 +149,8 @@ def quantize(w: jnp.ndarray, axis=-1,
                        scale=scale.astype(compute_dtype))
 
 
-def repack_nibbles_grouped(w: QuantTensor4, groups: int) -> QuantTensor4:
+def repack_nibbles_grouped(w: QuantTensor4, groups: int
+                           ) -> "QuantTensor4 | QuantTensor4Grouped":
     """Re-pack a split-half ``QuantTensor4`` so each of ``groups``
     CONTIGUOUS column groups is split-half packed WITHIN the group.
 
@@ -138,9 +168,12 @@ def repack_nibbles_grouped(w: QuantTensor4, groups: int) -> QuantTensor4:
     The result is only correct to consume SHARD-LOCALLY (inside a
     shard_map whose spec splits the packed axis into exactly ``groups``
     parts); a global ``dq()`` of a grouped-packed tensor interleaves
-    wrongly.  Engines therefore repack at the sharding boundary
-    (parallel/pipeline.shard_stacked_layers) and keep the plain layout
-    everywhere else.
+    wrongly.  The returned ``QuantTensor4Grouped`` type enforces exactly
+    that: ``dq``/``gather_rows`` raise on it, and shard-local consumers
+    unwrap to a plain ``QuantTensor4`` at the shard_map boundary
+    (parallel/pipeline._stage_local_params).  Engines repack at the
+    sharding boundary (pipeline.shard_stacked_layers) and keep the plain
+    layout everywhere else.
     """
     if groups <= 1:
         return w
@@ -154,11 +187,27 @@ def repack_nibbles_grouped(w: QuantTensor4, groups: int) -> QuantTensor4:
     g = c // groups
     grouped = unpacked.reshape(*unpacked.shape[:-1], groups, g)
     packed = _pack_nibbles(grouped)               # [..., groups, g/2]
-    return QuantTensor4(q=packed.reshape(*w.q.shape), scale=w.scale)
+    return QuantTensor4Grouped(q=packed.reshape(*w.q.shape), scale=w.scale)
+
+
+def _reject_grouped(w: Any, op: str) -> None:
+    if isinstance(w, QuantTensor4Grouped):
+        raise ValueError(
+            f"{op} on a grouped-repacked int4 tensor "
+            f"(QuantTensor4Grouped {w.q.shape}): its packed axis is "
+            f"split-half WITHIN each shard group, so a global unpack "
+            f"interleaves columns wrongly.  Consume it shard-locally "
+            f"(inside a shard_map splitting the packed axis into the "
+            f"repack's group count, unwrapping via "
+            f"pipeline._stage_local_params) or keep the plain "
+            f"QuantTensor4 layout")
 
 
 def dq(w: Any) -> jnp.ndarray:
-    """Dequantize a QuantTensor/QuantTensor4; pass plain arrays through."""
+    """Dequantize a QuantTensor/QuantTensor4; pass plain arrays through.
+    Grouped-repacked tensors (``QuantTensor4Grouped``) raise: their packed
+    layout is only meaningful shard-locally."""
+    _reject_grouped(w, "global dq()")
     if isinstance(w, QuantTensor):
         return w.q.astype(w.scale.dtype) * w.scale
     if isinstance(w, QuantTensor4):
@@ -171,6 +220,7 @@ def gather_rows(w: Any, idx: jnp.ndarray) -> jnp.ndarray:
     dequantized table: gathers int8 rows and their row scales.  Requires
     the table to be quantized with axis=0 (per-row), which is also the
     right channel axis for its use as the tied LM head."""
+    _reject_grouped(w, "global gather_rows()")
     if isinstance(w, (QuantTensor, QuantTensor4)):
         # fail loudly on a per-column table: scale[idx] would be an
         # out-of-bounds gather that JAX silently clamps to row 0
@@ -199,6 +249,11 @@ def quantize_params(params: Any, compute_dtype=jnp.bfloat16,
     ``bits=4`` nibble-packs (see module docstring).
     """
     def _quantize_entry(path, w):
+        if isinstance(w, QuantTensor4Grouped):
+            raise ValueError(
+                f"param at {jax.tree_util.keystr(path)} is grouped-"
+                f"repacked (QuantTensor4Grouped) — a shard-local layout "
+                f"that must not re-enter global quantization")
         if isinstance(w, (QuantTensor, QuantTensor4)):      # idempotent
             # ... but only at the SAME width: silently passing an int8 tree
             # through a bits=4 request would hand the caller double the
@@ -223,7 +278,8 @@ def quantize_params(params: Any, compute_dtype=jnp.bfloat16,
 
     return jax.tree_util.tree_map_with_path(
         _quantize_entry, params,
-        is_leaf=lambda x: isinstance(x, (QuantTensor, QuantTensor4)))
+        is_leaf=lambda x: isinstance(x, (QuantTensor, QuantTensor4,
+                                         QuantTensor4Grouped)))
 
 
 def quantizing_transform(compute_dtype=jnp.bfloat16, bits: int = 8):

@@ -103,6 +103,7 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                            "args": {"h2d_uploads": s.h2d_uploads,
                                     "d2h_syncs": s.d2h_syncs,
                                     "dispatches": s.dispatches,
+                                    "prefill_chunks": s.prefill_chunks,
                                     "cluster_queue_depth":
                                     s.cluster_queue_depth,
                                     "cluster_occupancy":

@@ -42,6 +42,11 @@ class TickSample:
     h2d_uploads: float = 0.0
     d2h_syncs: float = 0.0
     dispatches: float = 0.0
+    # chunked-prefill dispatches this tick (EngineConfig
+    # .prefill_chunk_budget): how many in-progress long prompts advanced
+    # one <=budget chunk — nonzero ticks are the spread-out prefill the
+    # budget bought instead of a monolithic stall
+    prefill_chunks: float = 0.0
     # cluster attribution (cluster/): which replica's engine recorded
     # this sample (0 outside a cluster — also the Chrome counter-track
     # tid, so per-replica tracks separate in Perfetto), plus the

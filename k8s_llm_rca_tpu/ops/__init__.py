@@ -5,3 +5,11 @@ from k8s_llm_rca_tpu.ops.attention import (  # noqa: F401
     decode_attention,
     repeat_kv,
 )
+from k8s_llm_rca_tpu.ops.quant_matmul import (  # noqa: F401
+    qmm,
+    qmm_experts,
+    qmm_head,
+    quant_matmul,
+    quant_matmul_experts,
+    quant_matmul_head,
+)

@@ -68,10 +68,31 @@ def _gpipe_loop(stage_apply: Callable, x_mb: jnp.ndarray, kv: Tuple,
     return jax.lax.psum(contrib, stage_axis), kv
 
 
+def _stage_local_params(tree):
+    """Unwrap grouped-repacked int4 leaves at the shard_map boundary.
+
+    Inside a stage body each ``QuantTensor4Grouped`` leaf is this TP
+    shard's contiguous block of the grouped packing — by construction a
+    self-contained split-half buffer of its own columns (quant.
+    repack_nibbles_grouped, "shard first, pack second") — so the local
+    view IS a plain ``QuantTensor4`` and the stage code's ``dq()`` stays
+    correct.  Globally the same leaves refuse ``dq()`` loudly; this
+    unwrap is the one sanctioned crossing."""
+    from k8s_llm_rca_tpu.models.quant import (
+        QuantTensor4, QuantTensor4Grouped,
+    )
+
+    return jax.tree.map(
+        lambda v: (QuantTensor4(q=v.q, scale=v.scale)
+                   if isinstance(v, QuantTensor4Grouped) else v),
+        tree, is_leaf=lambda v: isinstance(v, QuantTensor4Grouped))
+
+
 def _stage_local_init(stage_layers, axis_name: str):
     n_stages = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     params = jax.tree.map(lambda a: a[0], stage_layers)   # strip stage dim
+    params = _stage_local_params(params)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     return n_stages, my, params, perm
 
@@ -170,8 +191,10 @@ def _stacked_in_specs(stacked: Any, cfg, stage_axis: str,
     runtime.sharding.shard_pytree's placement so the shard_map view
     matches where the bytes already live.  For int4 the q spec applies
     to the PACKED axis, which shard_stacked_layers re-packed per shard
-    so the local blocks are self-contained."""
-    from k8s_llm_rca_tpu.models.quant import QuantTensor, QuantTensor4
+    (``QuantTensor4Grouped``) so the local blocks are self-contained."""
+    from k8s_llm_rca_tpu.models.quant import (
+        QuantTensor, QuantTensor4, QuantTensor4Grouped,
+    )
 
     if tp_axis is None and ep_axis is None:
         return P(stage_axis)
@@ -179,7 +202,7 @@ def _stacked_in_specs(stacked: Any, cfg, stage_axis: str,
     out = {}
     for k, v in stacked.items():
         spec = base[k]
-        if isinstance(v, (QuantTensor, QuantTensor4)):
+        if isinstance(v, (QuantTensor, QuantTensor4, QuantTensor4Grouped)):
             full = tuple(spec) + (None,) * (v.q.ndim - len(spec))
             scale_spec = P(*(s if d > 1 else None
                              for s, d in zip(full, v.scale.shape)))

@@ -129,12 +129,16 @@ def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
     the per-channel scale takes it with every size-1 (reduced) dim
     replicated — so TP composes with int8/int4 params.
     """
-    from k8s_llm_rca_tpu.models.quant import QuantTensor, QuantTensor4
+    from k8s_llm_rca_tpu.models.quant import (
+        QuantTensor, QuantTensor4, QuantTensor4Grouped,
+    )
+
+    quant_types = (QuantTensor, QuantTensor4, QuantTensor4Grouped)
 
     def _put(x, spec):
         if x is None:
             return None
-        if isinstance(x, (QuantTensor, QuantTensor4)):
+        if isinstance(x, quant_types):
             scale_spec = P(*(s if dim > 1 else None
                              for s, dim in zip(spec, x.scale.shape)))
             return type(x)(
@@ -144,8 +148,7 @@ def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
 
     return jax.tree.map(
         _put, tree, specs,
-        is_leaf=lambda x: x is None or isinstance(x, (QuantTensor,
-                                                      QuantTensor4)))
+        is_leaf=lambda x: x is None or isinstance(x, quant_types))
 
 
 def constrain(x, mesh: Mesh, spec: P):
