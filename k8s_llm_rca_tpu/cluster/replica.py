@@ -17,6 +17,14 @@ backends (OracleBackend, EchoBackend — ``_inflight`` dicts) and the real
 ``EngineBackend`` (``_live`` + engine slots) all serve as replicas; the
 cluster chaos soak runs 100 incidents on oracle replicas for exactly
 this reason (tier-1 budget).
+
+Overload composition (docs/serving.md "overload & priorities"): the
+router admits by priority class against ``queue_depth()`` (CRITICAL
+cap-exempt, BATCH one slot short), and migration preserves the class —
+``fail_replica`` re-starts with the run's original GenOptions (priority
+AND deadline_s ride along) while ``drain_replica`` adopts engine
+snapshots whose sequence entries now carry priority and the absolute
+engine deadline.
 """
 
 from __future__ import annotations

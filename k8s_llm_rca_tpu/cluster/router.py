@@ -105,15 +105,24 @@ class ClusterRouter:
 
     # -------------------------------------------------------------- routing
 
-    def _has_capacity(self, replica: Replica) -> bool:
-        return (self.max_inflight is None
-                or replica.queue_depth() < self.max_inflight)
+    def _has_capacity(self, replica: Replica, priority: int = 1) -> bool:
+        """Priority-tiered admission (docs/serving.md "overload &
+        priorities"): CRITICAL (priority <= 0) is cap-EXEMPT — never shed
+        while any replica is alive; NORMAL fills up to the inflight cap;
+        BATCH (priority >= 2) stops one slot short, reserving headroom so
+        backpressure sheds BATCH strictly before NORMAL."""
+        if priority <= 0 or self.max_inflight is None:
+            return True
+        cap = self.max_inflight if priority == 1 else self.max_inflight - 1
+        return replica.queue_depth() < cap
 
-    def _pick(self, session: str, admit: bool = True) -> int:
+    def _pick(self, session: str, admit: bool = True,
+              priority: int = 1) -> int:
         """Deterministic replica choice; raises RouterAdmissionError when
-        the cluster is saturated.  ``admit=False`` is the failover path:
-        the run was ALREADY admitted, so the inflight cap does not apply
-        — a kill must never shed work the cluster accepted."""
+        the cluster is saturated for the request's priority class.
+        ``admit=False`` is the failover path: the run was ALREADY
+        admitted, so the inflight cap does not apply — a kill must never
+        shed work the cluster accepted."""
         alive = self.alive_ids()
         if not alive:
             raise RouterAdmissionError("no alive replica")
@@ -122,14 +131,16 @@ class ClusterRouter:
             if pinned is not None and not self.replicas[pinned].alive:
                 pinned = None               # re-pin below
             if pinned is not None and (not admit or self._has_capacity(
-                    self.replicas[pinned])):
+                    self.replicas[pinned], priority)):
                 return pinned
         open_ = [rid for rid in alive
-                 if not admit or self._has_capacity(self.replicas[rid])]
+                 if not admit or self._has_capacity(self.replicas[rid],
+                                                    priority)]
         if not open_:
             raise RouterAdmissionError(
                 f"all {len(alive)} alive replicas at inflight cap "
-                f"{self.max_inflight}; shedding request")
+                f"{self.max_inflight} for priority {priority}; "
+                "shedding request")
         rid = min(open_, key=lambda r: (self.replicas[r].queue_depth(), r))
         if session and self._affinity.get(session) not in alive:
             self._affinity[session] = rid   # (re-)pin; overflow keeps pin
@@ -138,7 +149,7 @@ class ClusterRouter:
     # ------------------------------------------------------------- protocol
 
     def start(self, prompt: str, opts: GenOptions) -> int:
-        rid = self._pick(opts.session)
+        rid = self._pick(opts.session, priority=opts.priority)
         replica = self.replicas[rid]
         lhandle = replica.backend.start(prompt, opts)
         ghandle = next(self._handles)

@@ -238,6 +238,17 @@ class EngineConfig:
     # guaranteed; cp_mesh/pp_mesh and the contiguous engine are excluded
     # (loud ValueErrors).
     prefill_chunk_budget: int = 0
+    # overload survival (paged engine only; docs/serving.md "overload &
+    # priorities"): when > 0, a preempted sequence spills its written KV
+    # pages to host buffers (one coalesced d2h fetch) and resumes by h2d
+    # page restore instead of re-prefill — byte-identical greedy output,
+    # no re-burned prefill FLOPs.  The value caps the TOTAL host-resident
+    # spilled pages; a preemption that would exceed it falls back to the
+    # free-and-re-prefill path.  0 = off (today's behavior).  Excluded
+    # (loud ValueError) on cp_mesh (page axis sequence-sharded) and
+    # pp_mesh (pool layer axis stage-sharded) and on the contiguous
+    # engine.
+    max_spilled_pages: int = 0
 
 
 @dataclass(frozen=True)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -400,7 +401,7 @@ class SequenceResult:
     seq_id: int
     token_ids: List[int]
     text: str
-    finish_reason: str          # "stop" | "eos" | "length"
+    finish_reason: str          # "stop" | "eos" | "length" | "expired"
     prompt_tokens: int
     completion_tokens: int
 
@@ -415,6 +416,10 @@ class _Active:
     stop_strings: Tuple[str, ...] = ()
     grammar: Optional[object] = None    # engine/constrain.py FSM (stateful)
     n_shared: int = 0   # leading block-table pages owned by the prefix cache
+    # scheduling class (serve.backend.Priority; lower = more urgent):
+    # orders admission and preemption-victim selection.  Deadlines live in
+    # the engine's _deadlines registry, not on the sequence records.
+    priority: int = 1
 
 
 @dataclass
@@ -424,6 +429,7 @@ class _Pending:
     max_new_tokens: int
     stop_strings: Tuple[str, ...]
     grammar: Optional[object] = None
+    priority: int = 1
 
 
 class EngineBase:
@@ -462,6 +468,21 @@ class EngineBase:
     _inflight: Optional[List[dict]] = None
     _admit_pending: Optional[list] = None
     _flushed_out: Optional[list] = None
+    # per-sequence absolute deadlines (seq_id -> time on ``_now``'s
+    # clock), lazily created like ``_counts`` so engines without
+    # deadlines pay one falsy check per tick.  ``clock``: injectable
+    # time() source; None = the armed fault plan's VirtualClock when
+    # present, else wall time — the same discipline as faults/ and
+    # serve/api.py
+    clock = None
+    _deadlines: Optional[Dict[int, float]] = None
+
+    def _now(self) -> float:
+        if self.clock is not None:
+            return self.clock.time()
+        if inject._ARMED is not None:
+            return inject._ARMED.clock.time()
+        return time.time()
 
     # -------------------------------------------------------- shared api
 
@@ -503,18 +524,116 @@ class EngineBase:
         max_new_tokens: Optional[int] = None,
         stop_strings: Sequence[str] = (),
         grammar: Optional[object] = None,
+        priority: int = 1,
+        deadline_s: Optional[float] = None,
     ) -> int:
         """Queue a sequence; returns its seq_id.  Non-blocking.
 
         ``grammar``: optional constrain.py FSM owned by this sequence; the
-        engine consults it every tick (forced tokens / logit masks)."""
+        engine consults it every tick (forced tokens / logit masks).
+        ``priority``: scheduling class (serve.backend.Priority; lower =
+        more urgent) ordering admission and victim selection.
+        ``deadline_s``: seconds from now on the injectable clock; past it
+        the tick loop reaps the sequence (finish_reason "expired", pages
+        freed the same tick — never held until the client polls)."""
         seq_id = next(self._seq_counter)
         prompt_ids, max_new = self._clamp_prompt(prompt_ids, max_new_tokens)
         self._register(seq_id, prompt_ids)
-        self._pending.append(
+        self._enqueue(
             _Pending(seq_id, prompt_ids, max_new, tuple(stop_strings),
-                     grammar))
+                     grammar, priority=int(priority)))
+        if deadline_s is not None:
+            self._deadline_set(seq_id, self._now() + float(deadline_s))
         return seq_id
+
+    def _deadline_set(self, seq_id: int, deadline: float) -> None:
+        if self._deadlines is None:
+            self._deadlines = {}
+        self._deadlines[seq_id] = float(deadline)
+
+    def _enqueue(self, req: "_Pending", front: bool = False) -> None:
+        """Deterministic priority insert into the pending queue: stable
+        FIFO within a class (submission order is the tiebreak), lower
+        ``priority`` ints ahead.  ``front=True`` (preemption requeue)
+        puts the request ahead of its OWN class — a preempted sequence
+        resumes before un-admitted peers, preserving the paged engine's
+        always-makes-progress invariant.  All-NORMAL traffic degenerates
+        to exactly the old append / insert(0) behavior."""
+        pri = req.priority
+        for i, r in enumerate(self._pending):
+            if (r.priority > pri) if not front else (r.priority >= pri):
+                self._pending.insert(i, req)
+                return
+        self._pending.append(req)
+
+    def _reap_deadlines(self) -> List["SequenceResult"]:
+        """Retire every sequence whose deadline has passed — called at
+        the top of each tick, BEFORE the flush drain, so the expired
+        results surface from the same ``step()``.  Pages/slots free NOW
+        (the eager half of the serve-layer timeout: an expired run must
+        not hold pool pages until its client polls).  Disarmed path cost:
+        one falsy-dict check."""
+        if not self._deadlines:
+            return []
+        now = self._now()
+        expired = [sid for sid, dl in self._deadlines.items() if now >= dl]
+        if not expired:
+            return []
+        self._overlap_barrier()   # commit in-flight tokens before retiring
+        out: List[SequenceResult] = []
+        for seq_id in expired:
+            self._deadlines.pop(seq_id, None)
+            done = False
+            for i, req in enumerate(self._pending):
+                if req.seq_id == seq_id:
+                    del self._pending[i]
+                    out.append(self._expired_result(seq_id, req))
+                    self._drop_spill(seq_id)
+                    self._prompts.pop(seq_id, None)
+                    resumed = getattr(self, "_resumed", None)
+                    if resumed is not None:
+                        resumed.pop(seq_id, None)
+                    done = True
+                    break
+            if not done:
+                for slot, st in list(self._active.items()):
+                    if st.seq_id == seq_id:
+                        out.append(self._retire(slot, "expired"))
+                        done = True
+                        break
+            if not done:
+                res = self._expire_extra(seq_id)
+                if res is not None:
+                    out.append(res)
+                    done = True
+            if done:
+                self._count("engine.deadline_expirations")
+        return out
+
+    def _expired_result(self, seq_id: int,
+                        req: "_Pending") -> "SequenceResult":
+        """Terminal result for a sequence that expired while QUEUED: its
+        record is whatever it had generated before preemption (possibly
+        nothing) — mirroring what snapshot_sequences exports for pending
+        entries."""
+        resumed = getattr(self, "_resumed", None) or {}
+        gen = list(resumed.get(seq_id, ()))
+        prompt = list(self._prompts.get(seq_id, req.prompt_ids))
+        return SequenceResult(
+            seq_id=seq_id, token_ids=list(gen),
+            text=self._final_text(gen, "expired", req.stop_strings),
+            finish_reason="expired", prompt_tokens=len(prompt),
+            completion_tokens=len(gen))
+
+    def _expire_extra(self, seq_id: int) -> Optional["SequenceResult"]:
+        """Subclass hook: reap a deadline-expired sequence living outside
+        the pending/active books (the paged engine's chunked-prefill
+        slots)."""
+        return None
+
+    def _drop_spill(self, seq_id: int) -> None:
+        """Subclass hook: discard a sequence's host-spilled KV record (no
+        pages to free on the base engine)."""
 
     def _register(self, seq_id: int, prompt_ids: List[int]) -> None:
         """Subclass hook called once per submitted sequence."""
@@ -530,6 +649,9 @@ class EngineBase:
         for i, req in enumerate(self._pending):
             if req.seq_id == seq_id:
                 del self._pending[i]
+                self._drop_spill(seq_id)
+                if self._deadlines:
+                    self._deadlines.pop(seq_id, None)
                 self._prompts.pop(seq_id, None)
                 resumed = getattr(self, "_resumed", None)
                 if resumed is not None:
@@ -582,11 +704,17 @@ class EngineBase:
                     1, st.max_new_tokens - len(st.generated)),
                 "stop_strings": list(st.stop_strings),
                 "grammar": st.grammar is not None,
+                "priority": st.priority,
+                "deadline": (self._deadlines or {}).get(st.seq_id),
             })
         for req in self._pending:
             gen = list(resumed.get(req.seq_id, ()))
             # a preempted request's prompt_ids already carry its generated
-            # prefix; recover the ORIGINAL prompt from _prompts
+            # prefix; recover the ORIGINAL prompt from _prompts.  A
+            # KV-spilled sequence (paged engine) sits in this queue too,
+            # so it snapshots as exactly its token record — the spill
+            # buffers themselves are process-local device-layout memory
+            # and are never serialized
             prompt = list(self._prompts.get(req.seq_id, req.prompt_ids))
             seqs.append({
                 "seq_id": req.seq_id,
@@ -595,6 +723,8 @@ class EngineBase:
                 "remaining_new_tokens": req.max_new_tokens,
                 "stop_strings": list(req.stop_strings),
                 "grammar": req.grammar is not None,
+                "priority": req.priority,
+                "deadline": (self._deadlines or {}).get(req.seq_id),
             })
         key = jax.device_get(self._key)
         return {"rng_key": [int(x) for x in key], "sequences": seqs}
@@ -656,9 +786,12 @@ class EngineBase:
             self._register(seq_id, prompt)
             if gen:
                 resumed[seq_id] = list(gen)
-            self._pending.append(_Pending(
+            self._enqueue(_Pending(
                 seq_id, prompt + gen, remaining,
-                tuple(s["stop_strings"]), g))
+                tuple(s["stop_strings"]), g,
+                priority=int(s.get("priority", 1))))
+            if s.get("deadline") is not None:
+                self._deadline_set(seq_id, float(s["deadline"]))
             restored.append(seq_id)
             max_seen = max(max_seen, seq_id)
         # later submits must not reuse a restored id
@@ -962,8 +1095,18 @@ class EngineBase:
     def _tick_gauges(self) -> Dict[str, Optional[int]]:
         """Scheduler gauges for the tick timeline; the paged engine
         overrides to add pool pressure (free/evictable pages)."""
+        crit = norm = batch = 0
+        for r in self._pending:
+            if r.priority <= 0:
+                crit += 1
+            elif r.priority == 1:
+                norm += 1
+            else:
+                batch += 1
         return {"running": len(self._active),
                 "queued": len(self._pending),
+                "queued_critical": crit, "queued_normal": norm,
+                "queued_batch": batch,
                 "free_pages": None, "evictable_pages": None}
 
     def _record_tick(self, tr) -> None:
@@ -987,6 +1130,12 @@ class EngineBase:
             d2h_syncs=c.get("engine.d2h_syncs", 0.0),
             dispatches=c.get("engine.dispatches", 0.0),
             prefill_chunks=c.get("engine.prefill_chunks", 0.0),
+            spilled_pages=c.get("engine.spilled_pages", 0.0),
+            restored_pages=c.get("engine.restored_pages", 0.0),
+            deadline_expirations=c.get("engine.deadline_expirations", 0.0),
+            queued_critical=g.get("queued_critical", 0),
+            queued_normal=g.get("queued_normal", 0),
+            queued_batch=g.get("queued_batch", 0),
             engine_id=self.obs_replica or 0,
             cluster_queue_depth=(self._cluster_gauges or {}).get(
                 "queue_depth", 0.0),
@@ -1493,6 +1642,12 @@ class InferenceEngine(EngineBase):
                 "spread a prompt across ticks (its prefill writes one "
                 "monolithic slot slice).  Use paged=True "
                 "(PagedInferenceEngine) or prefill_chunk_budget=0")
+        if engine_cfg.max_spilled_pages:
+            raise ValueError(
+                "max_spilled_pages (KV spill-to-host preemption) requires "
+                "the paged engine: the contiguous cache has no page pool "
+                "to spill from and never preempts.  Use paged=True "
+                "(PagedInferenceEngine) or max_spilled_pages=0")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],
@@ -1767,7 +1922,7 @@ class InferenceEngine(EngineBase):
         lags one-to-two ticks behind (_overlap_step_tick); every other
         path flushes the lag first, so it observes fully committed
         state."""
-        finished: List[SequenceResult] = []
+        finished: List[SequenceResult] = self._reap_deadlines()
         if self._flushed_out:
             finished.extend(self._flushed_out)
             self._flushed_out = []
@@ -2016,6 +2171,8 @@ class InferenceEngine(EngineBase):
 
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)
+        if self._deadlines:
+            self._deadlines.pop(st.seq_id, None)
         self._free_slots.append(slot)
         # a crash-restored sequence's st.generated holds only post-restore
         # tokens and its admitted prompt carried the pre-crash generation;

@@ -988,6 +988,30 @@ class PagedInferenceEngine(EngineBase):
                     "across ticks would interleave its stage schedule "
                     "with the GPipe decode microbatches — serve PP "
                     "engines with prefill_chunk_budget=0")
+        msp = engine_cfg.max_spilled_pages
+        if msp:
+            if msp < 0:
+                raise ValueError(
+                    f"max_spilled_pages={msp} must be >= 0 (0 disables "
+                    f"KV spill-to-host preemption)")
+            if cp_mesh is not None:
+                raise ValueError(
+                    "max_spilled_pages (KV spill-to-host) is unsupported "
+                    "with cp_mesh: the CP pool's PAGE axis is sequence-"
+                    "sharded, so one logical page is not one host buffer "
+                    "— a spill gather/restore scatter would reshard the "
+                    "pool through host memory every preemption; serve CP "
+                    "engines with max_spilled_pages=0 (free-and-re-"
+                    "prefill)")
+            if pp_mesh is not None:
+                raise ValueError(
+                    "max_spilled_pages (KV spill-to-host) is unsupported "
+                    "with pp_mesh: the pool's LAYER axis is stage-sharded "
+                    "(possibly across hosts over DCN), so spill d2h / "
+                    "restore h2d would issue cross-stage collectives that "
+                    "must interleave with the GPipe microbatch schedule "
+                    "deterministically on every process; serve PP engines "
+                    "with max_spilled_pages=0 (free-and-re-prefill)")
         self._cp_parts = 0
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
@@ -1183,6 +1207,14 @@ class PagedInferenceEngine(EngineBase):
                                                    #           generated tokens
         self._fault_pages: List[int] = []   # pages stolen by an injected
                                             # "oom" tick fault (one tick)
+        # KV spill-to-host (engine_cfg.max_spilled_pages; docs/serving.md
+        # "overload & priorities"): seq_id -> host record {k, v, k_scale,
+        # v_scale (np arrays, [L, n, page, ...]), n_pages, n_shared,
+        # shared_pages, length, cur_token}.  The sequence itself waits in
+        # _pending (so snapshot/cancel see it normally); _tick_admission
+        # restores it by h2d page scatter instead of re-prefill.
+        self._spilled: Dict[int, Dict[str, object]] = {}
+        self._spilled_pages_total = 0
 
         # donate the KV pool so XLA updates it in place — without donation
         # every tick copies the whole pool and peak HBM doubles.  (CPU has
@@ -1368,17 +1400,22 @@ class PagedInferenceEngine(EngineBase):
         tick's growth pass runs the real pool-pressure machinery), plus
         the base host-stall kinds."""
         if fault.kind == "preempt":
+            # forced preemption takes the normal victim path, INCLUDING
+            # KV spill-to-host when enabled — this is how chaos plans
+            # exercise the spill/restore machinery (faults/soak.py)
             for _ in range(max(1, fault.wave)):
-                if not self._preempt_youngest():
+                if not self._preempt_victim():
                     break
         elif fault.kind == "crash":
             # process-style teardown between ticks: EVERY active sequence
             # loses its device KV at once (what a worker kill does) and is
             # requeued for re-prefill — youngest first, so the requeue-at-
             # front discipline leaves the OLDEST sequence at the head and
-            # admission order is preserved deterministically
+            # admission order is preserved deterministically.  spill=False
+            # by design: a crash models DEVICE KV LOSS, and spilling the
+            # pages to host first would quietly defeat the fault
             n = 0
-            while self._preempt_youngest():
+            while self._preempt_victim(spill=False):
                 n += 1
             log.warning("tick fault 'crash': dropped device KV of %d "
                         "active sequence(s); all requeued for re-prefill",
@@ -1494,7 +1531,7 @@ class PagedInferenceEngine(EngineBase):
             self._dev_edit_token(slot, token)
 
     def _tick(self) -> List[SequenceResult]:
-        finished: List[SequenceResult] = []
+        finished: List[SequenceResult] = self._reap_deadlines()
         if self._flushed_out:
             # results finished by an out-of-tick flush (cancel/snapshot/
             # fault barrier) surface here so step() callers never lose them
@@ -1610,6 +1647,20 @@ class PagedInferenceEngine(EngineBase):
         finished: List[SequenceResult] = []
         budget = self.engine_cfg.prefill_chunk_budget
         while self._pending and self._free_slots:
+            if self._spilled and self._pending[0].seq_id in self._spilled:
+                # KV-spilled sequence at the head: resume by h2d page
+                # restore — no prefill dispatch, byte-identical decode
+                # state to the moment it was preempted
+                try:
+                    self._admit_spilled(self._pending[0])
+                except OutOfPages:
+                    # record kept; the pool refills on retirements and
+                    # the head retries next tick (never preempt to admit
+                    # — the anti-livelock rule below)
+                    self._count("engine.admission_rejections")
+                    break
+                del self._pending[:1]
+                continue
             if budget and len(self._pending[0].prompt_ids) > budget:
                 # long prompt: admit through the chunked-prefill path —
                 # the first chunk dispatches now, the rest spread one per
@@ -1665,12 +1716,12 @@ class PagedInferenceEngine(EngineBase):
         # Two passes: every slot's MANDATORY page first, then best-effort
         # lookahead across slots.  Interleaving them let an earlier slot's
         # scan-window lookahead drain the pool and push a later slot's
-        # mandatory grow into preempt_youngest — avoidable preemption churn
-        # under pool pressure.
+        # mandatory grow into a preemption — avoidable churn under pool
+        # pressure.
         chunk_goal = max(1, self.engine_cfg.decode_chunk)
         for slot in sorted(self._active):
             if slot not in self._active:
-                # a previous iteration's _preempt_youngest() evicted it
+                # a previous iteration's _preempt_victim() evicted it
                 continue
             # _covered_len, not the host mirror: with a lagged commit the
             # device is up to _overlap_lag steps ahead, and the NEXT
@@ -1686,7 +1737,7 @@ class PagedInferenceEngine(EngineBase):
                         self._grow(slot)
                         break
                     except OutOfPages:
-                        if not self._preempt_youngest(exclude=slot):
+                        if not self._preempt_victim(exclude=slot):
                             # evict this one instead (it cannot take a step)
                             self._preempt_slot(slot)
                             break
@@ -2157,6 +2208,8 @@ class PagedInferenceEngine(EngineBase):
         self._free_slots.append(slot)
         self._prompts.pop(seq_id, None)
         self._resumed.pop(seq_id, None)
+        if self._deadlines:
+            self._deadlines.pop(seq_id, None)
 
     @property
     def has_work(self) -> bool:
@@ -2189,6 +2242,8 @@ class PagedInferenceEngine(EngineBase):
                 "remaining_new_tokens": req.max_new_tokens,
                 "stop_strings": list(req.stop_strings),
                 "grammar": req.grammar is not None,
+                "priority": req.priority,
+                "deadline": (self._deadlines or {}).get(req.seq_id),
             })
         seqs = snap["sequences"]
         n_active = len(self._active)
@@ -2209,7 +2264,7 @@ class PagedInferenceEngine(EngineBase):
         st = _Active(seq_id=req.seq_id, slot=slot, prompt_tokens=n,
                      max_new_tokens=req.max_new_tokens,
                      stop_strings=req.stop_strings, grammar=req.grammar,
-                     n_shared=n_shared)
+                     n_shared=n_shared, priority=req.priority)
         self._active[slot] = st
         self.lengths[slot] = n
         self._dev_edit_len(slot, n)
@@ -2418,13 +2473,23 @@ class PagedInferenceEngine(EngineBase):
         self.block_tables[slot, idx] = page
         self._dev_edit_bt_row(slot)
 
-    def _preempt_youngest(self, exclude: Optional[int] = None) -> bool:
-        """Evict the most-recently-admitted active sequence; requeue it."""
+    def _preempt_victim(self, exclude: Optional[int] = None,
+                        spill: bool = True) -> bool:
+        """Evict one active sequence and requeue it: LOWEST priority
+        class first (largest priority int), youngest (most-recently-
+        admitted) within the class — so a BATCH sweep run always yields
+        pages before a CRITICAL incident does, and the pre-priority
+        behavior (plain youngest-first) is preserved exactly when every
+        sequence is NORMAL.  ``spill=False`` forces the free-and-
+        re-prefill path even when spill is enabled (the "crash" tick
+        fault models device KV loss)."""
         candidates = [s for s in self._active if s != exclude]
         if not candidates:
             return False
-        slot = max(candidates, key=lambda s: self._active[s].seq_id)
-        self._preempt_slot(slot)
+        slot = max(candidates,
+                   key=lambda s: (self._active[s].priority,
+                                  self._active[s].seq_id))
+        self._preempt_slot(slot, spill=spill)
         return True
 
     def _release_slot_pages(self, slot: int, st: _Active) -> None:
@@ -2438,31 +2503,182 @@ class PagedInferenceEngine(EngineBase):
         if private:
             self.allocator.free(private, owner=st.seq_id)
 
-    def _preempt_slot(self, slot: int) -> None:
+    def _preempt_slot(self, slot: int, spill: bool = True) -> None:
         st = self._active.pop(slot)
-        self._release_slot_pages(slot, st)
+        spilled = spill and self._maybe_spill(slot, st)
+        if not spilled:
+            self._release_slot_pages(slot, st)
         self.block_tables[slot] = TRASH_PAGE
         self._dev_edit_bt_row(slot)     # contain in-flight garbage writes
         self._free_slots.append(slot)
-        # requeue at the FRONT with context so far; re-prefill resumes it.
-        # generated-so-far moves into the resume prompt and is remembered in
+        # requeue at the FRONT (within the priority class) with context so
+        # far.  If the KV spilled, _tick_admission resumes it by h2d page
+        # restore; otherwise re-prefill resumes it.  Either way generated-
+        # so-far moves into the resume prompt and is remembered in
         # _resumed so the final SequenceResult still reports the ORIGINAL
         # prompt/completion split.
         prefix = self._resumed.get(st.seq_id, []) + st.generated
         self._resumed[st.seq_id] = prefix
         resumed_prompt = self._prompts[st.seq_id] + prefix
         remaining = max(1, st.max_new_tokens - len(st.generated))
-        log.info("preempting seq %d (slot %d, %d tokens) to free pages",
-                 st.seq_id, slot, len(resumed_prompt))
+        log.info("preempting seq %d (slot %d, %d tokens, %s) to free pages",
+                 st.seq_id, slot, len(resumed_prompt),
+                 "kv spilled" if spilled else "re-prefill")
         self._count("engine.preemptions", 1)
         # the grammar FSM rides along: its state already reflects every
         # generated token now baked into the resume prompt
-        self._pending.insert(0, _Pending(
+        self._enqueue(_Pending(
             st.seq_id, resumed_prompt, remaining, st.stop_strings,
-            st.grammar))
+            st.grammar, priority=st.priority), front=True)
+
+    def _maybe_spill(self, slot: int, st: _Active) -> bool:
+        """Spill a preempted slot's written private KV pages to host
+        buffers (ONE coalesced d2h gather) so the sequence later resumes
+        by h2d page restore instead of re-prefill.  Returns False — and
+        leaves the caller on the free-and-re-prefill path — when spill is
+        off, the slot's first token hasn't committed yet (deferred
+        admission under host_overlap: its KV-covered length is ambiguous),
+        a mid-chunk page is TRASH, or the host-page budget
+        (``EngineConfig.max_spilled_pages``) would be exceeded.
+
+        On success the private written pages are freed to the allocator
+        (the record holds host copies), the shared prefix pages KEEP their
+        prefix-cache refcounts (held by the record, transferred back to
+        the slot at restore) so they cannot be evicted while spilled."""
+        if not self.engine_cfg.max_spilled_pages:
+            return False
+        prefix = self._resumed.get(st.seq_id, []) + st.generated
+        if not prefix:
+            return False
+        # committed-state invariant (steady state):
+        #   lengths[slot] == prompt_tokens + len(generated) - 1
+        # a freshly-admitted slot whose deferred first token hasn't
+        # committed yet breaks it (lengths == prompt_tokens, generated
+        # empty) — not spillable, fall back to re-prefill
+        length = int(self.lengths[slot])
+        if length + 1 != st.prompt_tokens + len(st.generated):
+            return False
+        ps = self.page_size
+        n_written = -(-length // ps)
+        table = self.block_tables[slot]
+        shared = [int(p) for p in table[:st.n_shared]]
+        spill_idx = [int(p) for p in table[st.n_shared:n_written]]
+        if any(p == TRASH_PAGE for p in spill_idx):
+            return False
+        if (self._spilled_pages_total + len(spill_idx)
+                > self.engine_cfg.max_spilled_pages):
+            self._count("engine.spill_budget_fallbacks")
+            return False
+        extra = [int(p) for p in table[n_written:] if p != TRASH_PAGE]
+        with profiling.annotate("engine.spill"):
+            rec: Dict[str, object] = {
+                "n_pages": len(spill_idx), "n_shared": st.n_shared,
+                "shared_pages": shared, "length": length,
+                "cur_token": int(self.cur_tokens[slot]),
+            }
+            if spill_idx:
+                idx = jnp.asarray(np.asarray(spill_idx, np.int32))
+                gathered = [jnp.take(self.pool.k, idx, axis=1),
+                            jnp.take(self.pool.v, idx, axis=1)]
+                if self.pool.quantized:
+                    gathered += [jnp.take(self.pool.k_scale, idx, axis=1),
+                                 jnp.take(self.pool.v_scale, idx, axis=1)]
+                host = self._fetch(*gathered)
+                rec["k"], rec["v"] = host[0], host[1]
+                if self.pool.quantized:
+                    rec["k_scale"], rec["v_scale"] = host[2], host[3]
+            self._spilled[st.seq_id] = rec
+            self._spilled_pages_total += len(spill_idx)
+            self._count("engine.spilled_pages", len(spill_idx))
+        if spill_idx or extra:
+            self.allocator.free(spill_idx + extra, owner=st.seq_id)
+        return True
+
+    def _admit_spilled(self, req: _Pending) -> None:
+        """Resume a KV-spilled sequence: allocate a fresh page run (SAME
+        bucket math as ``_admit``'s re-prefill path, so allocator state
+        evolves identically either way), h2d-scatter the spilled pages
+        back, and re-register the slot at its exact preemption state — no
+        prefill dispatch, no re-sampled token, byte-identical decode."""
+        rec = self._spilled[req.seq_id]
+        ps = self.page_size
+        n_shared = int(rec["n_shared"])
+        length = int(rec["length"])
+        # resume prompt = original prompt + generated-so-far; its length
+        # is length + 1 (the last generated token is cur, its KV pending)
+        resume_len = length + 1
+        assert resume_len == len(req.prompt_ids), (resume_len,
+                                                   len(req.prompt_ids))
+        rest = resume_len - n_shared * ps
+        bucket = min(self._bucket(rest),
+                     (self.pages_per_seq - n_shared) * ps)
+        n_pages = bucket // ps
+        pages = self._alloc_seq_pages(range(n_shared, n_shared + n_pages),
+                                      owner=req.seq_id)
+        n_spill = int(rec["n_pages"])
+        with profiling.annotate("engine.restore"):
+            if n_spill:
+                idx = jnp.asarray(np.asarray(pages[:n_spill], np.int32))
+                k = self.pool.k.at[:, idx].set(jnp.asarray(rec["k"]))
+                v = self.pool.v.at[:, idx].set(jnp.asarray(rec["v"]))
+                if self.pool.quantized:
+                    self.pool = self.pool._replace(
+                        k=k, v=v,
+                        k_scale=self.pool.k_scale.at[:, idx].set(
+                            jnp.asarray(rec["k_scale"])),
+                        v_scale=self.pool.v_scale.at[:, idx].set(
+                            jnp.asarray(rec["v_scale"])))
+                else:
+                    self.pool = self.pool._replace(k=k, v=v)
+            slot = self._free_slots.pop(0)
+            table = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
+            table[:n_shared] = rec["shared_pages"]
+            table[n_shared:n_shared + n_pages] = pages
+            self.block_tables[slot] = table
+            # prompt_tokens counts the RESUME prompt (like the re-prefill
+            # path); _retire reports against _prompts/_resumed as usual.
+            # The shared pages' prefix-cache refs transfer from the spill
+            # record to the slot (released at retire, symmetric).
+            st = _Active(seq_id=req.seq_id, slot=slot,
+                         prompt_tokens=resume_len,
+                         max_new_tokens=req.max_new_tokens,
+                         stop_strings=req.stop_strings, grammar=req.grammar,
+                         n_shared=n_shared, priority=req.priority)
+            self._active[slot] = st
+            self.lengths[slot] = length
+            self.cur_tokens[slot] = int(rec["cur_token"])
+            self._dev_edit_len(slot, length)
+            self._dev_edit_token(slot, int(rec["cur_token"]))
+            self._dev_edit_bt_row(slot)
+            del self._spilled[req.seq_id]
+            self._spilled_pages_total -= n_spill
+            self._count("engine.restored_pages", n_spill)
+
+    def _drop_spill(self, seq_id: int) -> None:
+        """Discard a spill record (cancel / deadline expiry while queued):
+        free the host buffers and drop the shared-prefix refcounts the
+        record was holding."""
+        rec = self._spilled.pop(seq_id, None)
+        if rec is None:
+            return
+        self._spilled_pages_total -= int(rec["n_pages"])
+        if rec["shared_pages"] and self.prefix_cache is not None:
+            self.prefix_cache.release(rec["shared_pages"])
+
+    def _expire_extra(self, seq_id: int) -> Optional[SequenceResult]:
+        """Deadline-reap a mid-chunked-prefill sequence: build its result
+        BEFORE _abort_prefilling pops the _prompts/_resumed records."""
+        for slot, pst in list(self._prefilling.items()):
+            if pst["req"].seq_id == seq_id:
+                res = self._expired_result(seq_id, pst["req"])
+                self._abort_prefilling(slot)
+                return res
+        return None
 
     def _retire(self, slot: int, reason: str) -> SequenceResult:
         st = self._active.pop(slot)
+        if self._deadlines:
+            self._deadlines.pop(st.seq_id, None)
         self._release_slot_pages(slot, st)
         self.allocator.check()
         self.block_tables[slot] = TRASH_PAGE

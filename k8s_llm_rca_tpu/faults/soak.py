@@ -354,3 +354,153 @@ def report_bytes(report: Dict[str, Any]) -> bytes:
     """Canonical bytes of a soak report (the byte-identity check)."""
     return json.dumps(report, sort_keys=True,
                       separators=(",", ":")).encode()
+
+
+def run_overload_soak(seed: int = 0, n_runs: int = 100, spill: bool = True,
+                      max_spilled_pages: int = 96,
+                      max_new_tokens: int = 32) -> Dict[str, Any]:
+    """Mixed-priority overload soak on the paged TINY engine: ``n_runs``
+    incident prompts submitted up front (priorities cycling CRITICAL /
+    NORMAL / BATCH) under a scheduled preempt/oom tick-fault schedule, so
+    preemption waves bite while the queue is deep.
+
+    Returns ``{"report": ..., "stats": ...}``.  ``report`` is the
+    byte-identity surface: its bytes are IDENTICAL with ``spill`` on or
+    off, because greedy decode is path-independent — a preemption (KV
+    spill/restore OR free/re-prefill) never changes what any sequence
+    generates, only WHEN ticks happen (a restore admission samples no
+    token, so the spilled run's tick count shifts by one per resume).
+    The report therefore carries only per-run outcomes (priority, finish
+    reason, text, token counts) and NO tick-sensitive data — no fault
+    polls, no tick totals, and not the spill knob itself.  ``stats``
+    holds the tick-sensitive numbers (spilled/restored pages,
+    preemptions, engine_clean) for assertions OUTSIDE the identity
+    check."""
+    import jax
+
+    from k8s_llm_rca_tpu.config import TINY, EngineConfig
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.faults.plan import Fault
+    from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS
+    from k8s_llm_rca_tpu.models import llama
+    from k8s_llm_rca_tpu.serve.backend import Priority
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cfg = TINY.replace(max_seq_len=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    engine = make_engine(
+        cfg, EngineConfig(max_batch=4, max_seq_len=256,
+                          prefill_buckets=(256,),
+                          max_new_tokens=max_new_tokens, temperature=0.0,
+                          paged=True, page_size=16, num_pages=96,
+                          prefix_cache=False, decode_chunk=8,
+                          max_spilled_pages=(max_spilled_pages if spill
+                                             else 0)),
+        params, tok, use_kernel=False)
+    # explicit tick-fault schedule (indices, not rate-sampled): the two
+    # runs' tick counts drift once a spill lands, so a shared RATE plan
+    # would fire on different ticks — which is fine for byte-identity
+    # (outputs are path-independent) but explicit waves guarantee the
+    # spill path is actually exercised early, while the queue is deep
+    waves = [Fault(inject.SITE_ENGINE_TICK, i, kind, 0.0, wave=2)
+             for i, kind in ((6, "preempt"), (14, "oom"), (22, "preempt"),
+                             (30, "oom"), (45, "preempt"), (70, "preempt"))]
+    plan = FaultPlan(waves, seed=seed, clock=VirtualClock())
+    classes = (Priority.CRITICAL, Priority.NORMAL, Priority.BATCH)
+    order: List[int] = []
+    priorities: Dict[int, int] = {}
+    with inject.armed(plan):
+        for i in range(n_runs):
+            msg = INCIDENTS[i % len(INCIDENTS)].message
+            pri = classes[i % len(classes)]
+            sid = engine.submit(tok.encode(f"[inc {i}] {msg}")[:128],
+                                priority=pri)
+            order.append(sid)
+            priorities[sid] = pri
+        results = {}
+        while engine.has_work:
+            for r in engine.step():
+                results[r.seq_id] = r
+    runs = [{"priority": priorities[sid],
+             "finish": results[sid].finish_reason,
+             "text": results[sid].text,
+             "completion_tokens": results[sid].completion_tokens}
+            for sid in order]
+    report = {
+        "seed": seed, "n_runs": n_runs,
+        "runs": runs,
+        "by_status": {
+            s: sum(1 for r in runs if r["finish"] == s)
+            for s in sorted({r["finish"] for r in runs})},
+    }
+    engine.allocator.check()
+    counts = engine._counts or {}
+    stats = {
+        "spill_enabled": spill,
+        "spilled_pages": counts.get("engine.spilled_pages", 0.0),
+        "restored_pages": counts.get("engine.restored_pages", 0.0),
+        "spill_budget_fallbacks": counts.get(
+            "engine.spill_budget_fallbacks", 0.0),
+        "preemptions": counts.get("engine.preemptions", 0.0),
+        "engine_clean": bool(not engine.has_work
+                             and engine.allocator.n_free
+                             == engine.engine_cfg.num_pages - 1),
+    }
+    return {"report": report, "stats": stats}
+
+
+def run_saturation_scenario(n_replicas: int = 2, max_inflight: int = 2,
+                            n_requests: int = 12) -> Dict[str, Any]:
+    """Priority-tiered backpressure under saturation: a mixed-priority
+    burst against a small EchoBackend cluster WITHOUT pumping between
+    starts, so queue depths only grow.  CRITICAL is cap-exempt (always
+    admits while a replica is alive), NORMAL fills to the inflight cap,
+    BATCH stops one slot short — so the shed order is strictly BATCH
+    before NORMAL and never CRITICAL, each shed surfacing as the typed
+    ``RouterAdmissionError``.  Every admitted run then pumps to
+    completion (CRITICAL always completes)."""
+    from k8s_llm_rca_tpu.cluster import ClusterRouter, Replica
+    from k8s_llm_rca_tpu.cluster.router import RouterAdmissionError
+    from k8s_llm_rca_tpu.serve.backend import (
+        EchoBackend, GenOptions, Priority,
+    )
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    tok = get_tokenizer()
+    router = ClusterRouter(
+        [Replica(i, EchoBackend(tok)) for i in range(n_replicas)],
+        max_inflight_per_replica=max_inflight)
+    classes = (Priority.CRITICAL, Priority.NORMAL, Priority.BATCH)
+    outcomes: List[Dict[str, Any]] = []
+    handles: Dict[int, int] = {}
+    for i in range(n_requests):
+        pri = classes[i % len(classes)]
+        row: Dict[str, Any] = {"i": i, "priority": pri}
+        try:
+            handles[i] = router.start(f"incident {i}",
+                                      GenOptions(max_new_tokens=4,
+                                                 priority=pri))
+            row["admitted"] = True
+        except RouterAdmissionError as e:
+            row["admitted"] = False
+            row["error"] = type(e).__name__
+            row["detail"] = str(e)
+        outcomes.append(row)
+    results = {}
+    while any(router.busy(h) for h in handles.values()):
+        results.update(router.pump())
+    admitted = {p: sum(1 for o in outcomes
+                       if o["priority"] == p and o["admitted"])
+                for p in classes}
+    shed = {p: sum(1 for o in outcomes
+                   if o["priority"] == p and not o["admitted"])
+            for p in classes}
+    return {
+        "n_replicas": n_replicas, "max_inflight": max_inflight,
+        "outcomes": outcomes,
+        "admitted_by_class": admitted, "shed_by_class": shed,
+        "completed": sum(1 for i, h in handles.items()
+                         if results.get(h) is not None
+                         and results[h].error is None),
+    }

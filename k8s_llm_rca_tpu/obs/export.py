@@ -108,6 +108,14 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                                     s.cluster_queue_depth,
                                     "cluster_occupancy":
                                     s.cluster_occupancy}})
+            events.append({**base, "name": "engine.overload",
+                           "args": {"spilled_pages": s.spilled_pages,
+                                    "restored_pages": s.restored_pages,
+                                    "deadline_expirations":
+                                    s.deadline_expirations,
+                                    "queued_critical": s.queued_critical,
+                                    "queued_normal": s.queued_normal,
+                                    "queued_batch": s.queued_batch}})
     # stable sort: equal-ts events keep recording order, so the document
     # is a pure function of the recording (byte-identity under VirtualClock)
     events.sort(key=lambda e: e["ts"])
@@ -253,6 +261,26 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
         counts = getattr(engine, "_counts", None) or {}
         gauges["engine_prefix_hit_tokens"] = \
             counts.get("engine.prefix_hit_tokens", 0.0)
+        gauges["engine_spilled_pages"] = \
+            counts.get("engine.spilled_pages", 0.0)
+        gauges["engine_restored_pages"] = \
+            counts.get("engine.restored_pages", 0.0)
+        gauges["engine_deadline_expirations"] = \
+            counts.get("engine.deadline_expirations", 0.0)
+        # per-priority pending depth (guard: stub engines in tests queue
+        # bare objects without a priority attribute)
+        crit = norm = batch = 0
+        for p in getattr(engine, "_pending", ()):
+            pri = getattr(p, "priority", 1)
+            if pri <= 0:
+                crit += 1
+            elif pri == 1:
+                norm += 1
+            else:
+                batch += 1
+        gauges["engine_queued_critical"] = crit
+        gauges["engine_queued_normal"] = norm
+        gauges["engine_queued_batch"] = batch
         for key in sorted(gauges):
             family(f"{_PREFIX}{key}", "gauge",
                    f"live engine gauge {key!r}").add(gauges[key])

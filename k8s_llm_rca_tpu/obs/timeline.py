@@ -55,6 +55,17 @@ class TickSample:
     engine_id: int = 0
     cluster_queue_depth: float = 0.0
     cluster_occupancy: float = 0.0
+    # overload survival (docs/serving.md "overload & priorities"):
+    # cumulative KV pages spilled to host / restored from host and
+    # deadline-expired sequences reaped by the engine tick, plus the
+    # instantaneous pending-queue depth per priority class (CRITICAL /
+    # NORMAL / BATCH buckets of GenOptions.priority)
+    spilled_pages: float = 0.0
+    restored_pages: float = 0.0
+    deadline_expirations: float = 0.0
+    queued_critical: int = 0
+    queued_normal: int = 0
+    queued_batch: int = 0
 
 
 class TickTimeline:

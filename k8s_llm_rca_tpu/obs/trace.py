@@ -57,6 +57,10 @@ SITES = frozenset({
     "engine.prefill",
     "engine.decode_step",
     "engine.tick.eviction",
+    # overload survival (engine/paged.py): KV page spill-to-host on
+    # preemption and the h2d page restore that resumes the sequence
+    "engine.spill",
+    "engine.restore",
     # serve layer
     "serve.run_started",
     "serve.run",

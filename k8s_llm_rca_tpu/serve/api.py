@@ -237,16 +237,25 @@ class AssistantService:
                   created_at=int(self._clock.time()),
                   instructions_override=instructions)
         run.t_started = self._clock.time()
-        run.deadline = self._clock.time() + self.run_timeout_s
         self.runs[run.id] = run
         self._thread_runs[thread_id].append(run.id)
 
         prompt = render_prompt(assistant, self.threads[thread_id], instructions)
         # session = thread id: the cluster router's affinity key, so every
-        # run of a thread lands on the replica already holding its prefix
-        opts = dataclasses.replace(gen or assistant.gen,
+        # run of a thread lands on the replica already holding its prefix.
+        # Every run carries a concrete deadline into the ENGINE (eager
+        # in-tick reaping frees pages the moment it passes): the caller's
+        # GenOptions.deadline_s when set, else run_timeout_s — the serve-
+        # level poll expiry stays as a backstop at the tighter of the two.
+        base = gen or assistant.gen
+        deadline_s = (base.deadline_s if base.deadline_s is not None
+                      else self.run_timeout_s)
+        run.deadline = self._clock.time() + min(self.run_timeout_s,
+                                                deadline_s)
+        opts = dataclasses.replace(base,
                                    assistant_name=assistant.name,
-                                   session=thread_id)
+                                   session=thread_id,
+                                   deadline_s=deadline_s)
         run.usage["prompt_tokens"] = self.backend.count_tokens(prompt)
         run.backend_handle = self.backend.start(prompt, opts)
         run.status = RunStatus.IN_PROGRESS
@@ -392,7 +401,12 @@ class AssistantService:
             if handle in results:
                 res = results[handle]
                 if res.error is not None:
-                    run.status = RunStatus.FAILED
+                    # engine-reaped deadline expiry surfaces as its own
+                    # terminal status (pages already freed in-tick);
+                    # journal/recovery replay it verbatim
+                    run.status = (RunStatus.EXPIRED
+                                  if getattr(res, "expired", False)
+                                  else RunStatus.FAILED)
                     run.error = res.error
                 else:
                     run.status = RunStatus.COMPLETED

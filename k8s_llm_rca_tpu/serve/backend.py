@@ -28,6 +28,17 @@ from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
 
+class Priority:
+    """Request priority classes (small ints: LOWER value = MORE urgent,
+    so ``sorted()`` over (priority, seq_id) is the scheduling order).
+    The engine buckets anything <= CRITICAL as critical and anything
+    >= BATCH as batch for the per-priority queue gauges."""
+
+    CRITICAL = 0      # interactive / SLO-bound: never shed by the router
+    NORMAL = 1        # default
+    BATCH = 2         # offline sweeps: first shed under backpressure
+
+
 @dataclass(frozen=True)
 class GenOptions:
     max_new_tokens: int = 256
@@ -52,6 +63,17 @@ class GenOptions:
     # prompt keeps hitting the replica whose prefix cache already holds
     # its history.
     session: str = ""
+    # overload scheduling (docs/serving.md "overload & priorities"):
+    # ``priority`` orders engine admission and preemption-victim selection
+    # (Priority.CRITICAL/NORMAL/BATCH; lower = more urgent) and tiers the
+    # cluster router's backpressure (BATCH sheds before NORMAL, CRITICAL
+    # never sheds).  ``deadline_s`` is a per-run budget in seconds on the
+    # injectable clock (faults.plan.VirtualClock under chaos); the engine
+    # reaps an expired sequence inside its own tick — pages freed
+    # immediately, finish_reason "expired" — instead of waiting for the
+    # serve-layer poll.  None = serve default (RCAConfig.run_timeout_s).
+    priority: int = Priority.NORMAL
+    deadline_s: Optional[float] = None
 
 
 class BudgetError(ValueError):
@@ -66,6 +88,9 @@ class BackendResult:
     completion_tokens: int
     prompt_tokens: Optional[int] = None   # actual prefilled tokens if known
     error: Optional[str] = None
+    # the engine reaped the sequence past its deadline (finish_reason
+    # "expired"): the service settles the run as EXPIRED, not FAILED
+    expired: bool = False
 
 
 class LMBackend(Protocol):
@@ -184,7 +209,8 @@ class EngineBackend:
         stop = () if grammar is not None else opts.stop
         seq_id = self.engine.submit(
             ids, max_new_tokens=opts.max_new_tokens, stop_strings=stop,
-            grammar=grammar)
+            grammar=grammar, priority=opts.priority,
+            deadline_s=opts.deadline_s)
         self._seq_to_handle[seq_id] = handle
         self._handle_seq[handle] = seq_id
         self._opts[handle] = opts
@@ -214,6 +240,14 @@ class EngineBackend:
             if not live:                   # cancelled: drop, don't leak
                 continue
             text = opts.forced_prefix + res.text + opts.suffix
+            if res.finish_reason == "expired":
+                results[handle] = BackendResult(
+                    text=text,
+                    completion_tokens=res.completion_tokens,
+                    prompt_tokens=res.prompt_tokens,
+                    error="deadline exceeded (engine deadline reap)",
+                    expired=True)
+                continue
             results[handle] = BackendResult(
                 text=text,
                 completion_tokens=res.completion_tokens,
@@ -372,3 +406,8 @@ class EchoBackend:
 
     def count_tokens(self, text: str) -> int:
         return self.tokenizer.count(text)
+
+    def queue_depth(self) -> int:
+        # same load signal EngineBackend exposes, so the cluster router's
+        # capacity tiering is testable without a real engine
+        return len(self._inflight)

@@ -66,7 +66,8 @@ def encode_gen(gen) -> Optional[Dict[str, Any]]:
     return {"max_new_tokens": gen.max_new_tokens, "stop": list(gen.stop),
             "forced_prefix": gen.forced_prefix, "suffix": gen.suffix,
             "grammar": grammar, "assistant_name": gen.assistant_name,
-            "session": gen.session}
+            "session": gen.session,
+            "priority": gen.priority, "deadline_s": gen.deadline_s}
 
 
 def decode_gen(d: Optional[Dict[str, Any]]):
@@ -79,7 +80,9 @@ def decode_gen(d: Optional[Dict[str, Any]]):
         max_new_tokens=int(d["max_new_tokens"]), stop=tuple(d["stop"]),
         forced_prefix=d["forced_prefix"], suffix=d["suffix"],
         grammar=grammar, assistant_name=d.get("assistant_name", ""),
-        session=d.get("session", ""))   # pre-cluster journals lack it
+        session=d.get("session", ""),   # pre-cluster journals lack it
+        priority=d.get("priority", 1),  # pre-overload journals lack both
+        deadline_s=d.get("deadline_s"))
 
 
 class RunJournal:

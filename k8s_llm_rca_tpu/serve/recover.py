@@ -157,14 +157,22 @@ def recover_service(journal_path: str, backend, run_timeout_s: float = 600.0,
                 # session = thread id, re-stamped exactly as create_run
                 # does: a cluster router recovering the journal re-pins
                 # the thread's affinity instead of scattering its runs
+                base = decode_gen(rec["gen"]) or assistant.gen
+                # deadline re-stamped exactly as create_run does: the
+                # resubmitted run carries its priority AND a fresh engine
+                # deadline (the journal keeps deadline_s, not the absolute
+                # instant — a crash-restart grants the full window again)
+                deadline_s = (base.deadline_s if base.deadline_s is not None
+                              else run_timeout_s)
                 opts = dataclasses.replace(
-                    decode_gen(rec["gen"]) or assistant.gen,
+                    base,
                     assistant_name=assistant.name,
-                    session=rec["thread_id"])
+                    session=rec["thread_id"],
+                    deadline_s=deadline_s)
                 prompt = rec["prompt"]
                 run.usage["prompt_tokens"] = backend.count_tokens(prompt)
                 run.t_started = now()
-                run.deadline = now() + run_timeout_s
+                run.deadline = now() + min(run_timeout_s, deadline_s)
                 try:
                     run.backend_handle = backend.start(prompt, opts)
                 except BudgetError as e:

@@ -491,6 +491,28 @@ class TestSiteCoverage:
             router.fail_replica(router._handle_map[h][0])
             assert h in router.pump()
 
+        # (5) overload sites: preempt a victim on a spill-enabled engine
+        # so engine.spill (d2h) and engine.restore (h2d) both fire
+        tr_spill = Tracer(clock=VirtualClock())
+        tracers.append(tr_spill)
+        spill_eng = make_engine(
+            TINY.replace(max_seq_len=64),
+            EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                         page_size=8, num_pages=24,
+                         prefill_buckets=(16, 32), max_new_tokens=8,
+                         temperature=0.0, decode_chunk=1,
+                         prefix_cache=False, max_spilled_pages=24),
+            engine.params, tok, use_kernel=False)
+        with obs_trace.tracing(tr_spill):
+            spill_eng.submit(tok.encode("node notready"))
+            spill_eng.step()
+            spill_eng.step()
+            assert spill_eng._preempt_victim()
+            while spill_eng.has_work:
+                spill_eng.step()
+        assert {"engine.spill", "engine.restore"} \
+            <= tr_spill.emitted_names()
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:
