@@ -705,6 +705,116 @@ def bench_overload(n_runs: int = 30, max_new: int = 24,
             "runs": n_runs, "ticks": tick}
 
 
+def bench_selfheal(n_runs: int = 8, max_new: int = 24):
+    """Self-healing leg (cluster/health.py): one fresh interpreter, four
+    measurements, each measurement-or-null.
+
+    - ``mttd_s``: wall-clock from the wedged replica's last heartbeat to
+      the watchdog's DEAD verdict (the ``cluster.mttd`` span), with the
+      fleet mid-decode — detection latency is a function of the pump
+      cadence, so it is measured against REAL pumps on engine replicas,
+      never a frozen clock (the VirtualClock twin lives in
+      tests/test_selfheal.py, where it is exactly 0.0 by design).
+    - ``mttr_s``: DEAD verdict -> fresh incarnation rejoined (the
+      ``cluster.mttr`` span): rebuild on the original submesh +
+      re-sharding + the supervisor's warmup generation.
+    - ``restart_warmup_s``: host ``perf_counter`` around rebuild+warmup
+      alone (MTTR minus the detection plumbing) — the cost of forcing
+      the fresh engine's compile out of the serving path.
+    - ``quarantined``: exact poison-run count from a cheap scripted
+      scenario (a run whose replica dies twice settles FAILED with the
+      named quarantine error) — count-exact like ``shed_rate``.
+    """
+    from k8s_llm_rca_tpu.cluster import (
+        ClusterRouter, HealthPolicy, HealthWatchdog, Replica,
+        ReplicaSupervisor, build_replicas,
+    )
+    from k8s_llm_rca_tpu.serve.backend import EchoBackend, GenOptions
+
+    devices = jax.devices()
+    n_replicas = 2 if len(devices) >= 2 else 1
+    use = devices[:(len(devices) // n_replicas) * n_replicas]
+    cfg = TINY.replace(max_seq_len=512)
+    ecfg = EngineConfig(max_batch=4, max_seq_len=512, paged=True,
+                        page_size=16, num_pages=160,
+                        prefill_buckets=(64,), max_new_tokens=max_new,
+                        temperature=0.0, decode_chunk=4,
+                        prefix_cache=False)
+    router = ClusterRouter(build_replicas(cfg, ecfg, n_replicas,
+                                          devices=use))
+    # wall-clock watchdog (no injected clock): MTTD/MTTR are real time
+    wd = HealthWatchdog(HealthPolicy(miss_budget=2,
+                                     hung_tick_threshold=4))
+    sup = ReplicaSupervisor(warmup_prompt="selfheal warmup probe")
+    router.attach_health(wd, sup)
+
+    rng = np.random.default_rng(31)
+    words = ("pod", "node", "oom", "evicted", "crashloop", "pressure",
+             "namespace", "deployment", "restart", "taint")
+
+    def prompt(i):
+        picks = rng.integers(0, len(words), size=24)
+        return f"incident {i}: " + " ".join(words[int(p)] for p in picks)
+
+    # compile pass: one full generation per replica, excluded from the
+    # kill-and-heal measurement below
+    warm = [router.start(prompt(1000 + r),
+                         GenOptions(session=f"warm_{r}",
+                                    max_new_tokens=max_new))
+            for r in range(n_replicas)]
+    while any(router.busy(h) for h in warm):
+        router.pump()
+
+    handles = [router.start(prompt(i),
+                            GenOptions(session=f"th_{i % (2 * n_replicas)}",
+                                       max_new_tokens=max_new))
+               for i in range(n_runs)]
+    for _ in range(2):                       # runs decoding mid-flight
+        router.pump()
+    victim = max(router.alive_ids(),
+                 key=lambda r: (router.replicas[r].queue_depth(), r))
+    router.replicas[victim].wedge()          # the worker process "dies"
+    while (any(router.busy(h) for h in handles)
+           or not all(r.alive and not r.wedged
+                      for r in router.replicas.values())):
+        router.pump()
+
+    def _mean(xs):
+        return round(sum(xs) / len(xs), 4) if xs else None
+
+    # cheap scripted quarantine scenario: a poison run sinks its replica
+    # twice and must settle FAILED with the named error (count-exact)
+    tok = get_tokenizer()
+    q_router = ClusterRouter(
+        [Replica(i, EchoBackend(tok, delay_pumps=10 ** 9),
+                 rebuild=lambda tok=tok: EchoBackend(tok,
+                                                     delay_pumps=10 ** 9))
+         for i in range(2)],
+        quarantine_after=2)
+    q_router.attach_health(
+        HealthWatchdog(HealthPolicy(miss_budget=1, hung_tick_threshold=2)),
+        ReplicaSupervisor())
+    qh = q_router.start("poison", GenOptions(session="q"))
+    q_res = {}
+    for _ in range(2):
+        q_router.replicas[q_router._handle_map[qh][0]].wedge()
+        for _ in range(8):
+            q_res.update(q_router.pump())
+            if qh in q_res:
+                break
+    quarantined = (q_router.quarantined
+                   if qh in q_res and q_res[qh].error is not None
+                   and "quarantined" in q_res[qh].error else None)
+
+    return {"replicas": n_replicas,
+            "mttd_s": _mean(wd.mttd_s),
+            "mttr_s": _mean(sup.mttr_s),
+            "restart_warmup_s": _mean(sup.restart_s),
+            "restarts": len(sup.restarts),
+            "quarantined": quarantined,
+            "runs": n_runs}
+
+
 def bench_host_overlap(n_prompts: int = 48, max_batch: int = 8,
                        prompt_len: int = 64, max_new: int = 32):
     """Overlapped-hot-loop leg (docs/performance.md): the TINY paged
@@ -868,6 +978,7 @@ def main():
     resume = _leg("bench.bench_rca_resume()", timeout=1500) or {}
     cluster = _leg("bench.bench_cluster()", timeout=1500) or {}
     overload = _leg("bench.bench_overload()", timeout=1500) or {}
+    selfheal = _leg("bench.bench_selfheal()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -1028,6 +1139,14 @@ def main():
         "overload_shed_rate": overload.get("shed_rate"),
         "overload_p50_ttr_s": overload.get("p50_ttr_s"),
         "overload_p99_ttr_s": overload.get("p99_ttr_s"),
+        # self-healing (cluster/health.py): wall-clock detect/rejoin
+        # latencies of a mid-decode wedge on engine replicas plus the
+        # exact poison-run quarantine count, each measured in one fresh
+        # interpreter; null when the leg failed — schema stays stable
+        "selfheal_mttd_s": selfheal.get("mttd_s"),
+        "selfheal_mttr_s": selfheal.get("mttr_s"),
+        "selfheal_restart_warmup_s": selfheal.get("restart_warmup_s"),
+        "selfheal_quarantined": selfheal.get("quarantined"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

@@ -11,9 +11,18 @@ Layer map (PARITY.md §cluster, docs/cluster.md):
   service talks to: session affinity on thread id, queue-depth
   balancing, ``RouterAdmissionError`` backpressure, ``fail_replica``
   (kill + re-start on survivors) and ``drain_replica``
-  (snapshot/adopt migration with decode position).
+  (snapshot/adopt migration with decode position);
+- ``health.HealthWatchdog`` / ``HealthPolicy`` /
+  ``ReplicaSupervisor`` — the self-healing loop
+  (``router.attach_health``): deterministic ALIVE -> SUSPECT -> DEAD
+  liveness from tick/pump heartbeats, in-tree failover on DEAD,
+  restart-and-rejoin on the original submesh, and poison-run
+  quarantine after ``quarantine_after`` fatal incarnations.
 """
 
+from k8s_llm_rca_tpu.cluster.health import (ALIVE, DEAD, SUSPECT,
+                                            HealthPolicy, HealthWatchdog,
+                                            ReplicaSupervisor)
 from k8s_llm_rca_tpu.cluster.replica import (EngineReplica, Replica,
                                              build_replicas)
 from k8s_llm_rca_tpu.cluster.router import (ClusterRouter,
@@ -23,4 +32,6 @@ from k8s_llm_rca_tpu.cluster.submesh import carve_replica_meshes
 __all__ = [
     "carve_replica_meshes", "build_replicas", "Replica", "EngineReplica",
     "ClusterRouter", "RouterAdmissionError",
+    "HealthPolicy", "HealthWatchdog", "ReplicaSupervisor",
+    "ALIVE", "SUSPECT", "DEAD",
 ]

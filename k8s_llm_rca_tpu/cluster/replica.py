@@ -38,13 +38,34 @@ log = get_logger(__name__)
 
 
 class Replica:
-    """A replica slot in the cluster: id, backend, optional submesh."""
+    """A replica slot in the cluster: id, backend, optional submesh.
 
-    def __init__(self, replica_id: int, backend: Any, mesh=None):
+    ``rebuild``: optional zero-arg recipe returning a FRESH backend for
+    this slot — the restart-and-rejoin source (cluster/health.py
+    ``ReplicaSupervisor``).  ``build_replicas`` records one per engine
+    replica (re-shard the shared host params onto the SAME submesh);
+    scripted replicas pass their own.
+
+    ``wedged``: the in-tree stand-in for a dead worker process — the
+    backend object still exists (its engine stands in for the corpse's
+    device state) but the router stops pumping it, so it stops beating
+    and the health watchdog must detect it.  ``fail_replica`` is the
+    *consequence* of a wedge, never the injection itself.
+    """
+
+    def __init__(self, replica_id: int, backend: Any, mesh=None,
+                 rebuild=None):
         self.replica_id = replica_id
         self.backend = backend
         self.mesh = mesh
+        self.rebuild = rebuild
         self.alive = True
+        self.wedged = False
+
+    def wedge(self) -> None:
+        """Simulate this replica's process dying: it stays nominally
+        alive (nobody told the router) but never beats again."""
+        self.wedged = True
 
     def queue_depth(self) -> int:
         b = self.backend
@@ -120,7 +141,19 @@ def build_replicas(model_cfg, engine_cfg, n_replicas: int,
         engine = make_engine(model_cfg, engine_cfg, sharded, tok,
                              **engine_kw)
         engine.obs_replica = rid      # per-replica span/TickSample tag
-        replicas.append(Replica(rid, EngineBackend(engine), mesh=mesh))
+
+        def _rebuild(mesh=mesh, rid=rid, kw=dict(engine_kw)):
+            # restart-and-rejoin recipe (cluster/health.py): re-shard the
+            # SAME host params onto the replica's ORIGINAL submesh — the
+            # identical-replica invariant, so a restarted incarnation
+            # generates byte-identically to the first
+            eng = make_engine(model_cfg, engine_cfg,
+                              shard_pytree(params, specs, mesh), tok, **kw)
+            eng.obs_replica = rid
+            return EngineBackend(eng)
+
+        replicas.append(Replica(rid, EngineBackend(engine), mesh=mesh,
+                                rebuild=_rebuild))
     log.info("built %d engine replicas: %s devices each",
              len(replicas), meshes[0].devices.size if replicas else 0)
     return replicas

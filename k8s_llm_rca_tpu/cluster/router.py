@@ -43,6 +43,18 @@ still alive, so its sequences move WITH their decode position:
 place.  The re-prefill on the target is a prefix-cache mostly-HIT when
 the target has seen the session before (tests/test_cluster.py proves
 both the byte-identity and the hit-rate).
+
+Self-healing (``attach_health``; cluster/health.py, docs/cluster.md
+"Self-healing"): with a ``HealthWatchdog`` attached, every ``pump``
+probes replica liveness first — a newly-DEAD replica is quarantine-
+checked, failed over through the SAME ``fail_replica`` path (unchanged
+semantics, now triggered in-tree), and, when a restart-enabled
+``ReplicaSupervisor`` rides along, rebuilt on its original submesh so
+the fleet returns to N.  ``_pick`` routes new work around SUSPECT
+replicas while any fully-ALIVE replica has capacity.  Poison-run
+quarantine: a run whose replica dies ``quarantine_after`` times across
+incarnations settles FAILED with a named error through the normal pump
+result path (serve/api.py journals it; recovery replay agrees).
 """
 
 from __future__ import annotations
@@ -51,6 +63,7 @@ import itertools
 from typing import Any, Dict, List, Optional, Tuple
 
 from k8s_llm_rca_tpu.cluster.replica import Replica
+from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.serve.backend import BackendResult, GenOptions
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
@@ -68,16 +81,30 @@ class ClusterRouter:
     """LMBackend facade over N replicas.  See module docstring."""
 
     def __init__(self, replicas: List[Replica],
-                 max_inflight_per_replica: Optional[int] = None):
+                 max_inflight_per_replica: Optional[int] = None,
+                 quarantine_after: int = 2):
         if not replicas:
             raise ValueError("ClusterRouter needs at least one replica")
         ids = [r.replica_id for r in replicas]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate replica ids: {sorted(ids)}")
+        if quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1 (a poison run needs at "
+                f"least one fatal incarnation), got {quarantine_after}")
         self.replicas: Dict[int, Replica] = {
             r.replica_id: r for r in sorted(replicas,
                                             key=lambda r: r.replica_id)}
         self.max_inflight = max_inflight_per_replica
+        self.quarantine_after = quarantine_after
+        # self-healing attachments (attach_health; cluster/health.py)
+        self.health = None                  # HealthWatchdog
+        self.supervisor = None              # ReplicaSupervisor
+        # poison-run tracking: global handle -> fatal incarnations
+        self._deaths: Dict[int, int] = {}
+        # quarantine settlements awaiting the next pump's result dict
+        self._quarantined_results: Dict[int, "BackendResult"] = {}
+        self.quarantined = 0
         self._handles = itertools.count()
         # global handle -> (replica_id, local handle); rewritten on
         # migration, never surfaced to callers
@@ -103,6 +130,113 @@ class ClusterRouter:
         return {rid: r.occupancy()
                 for rid, r in self.replicas.items() if r.alive}
 
+    # --------------------------------------------------------- self-healing
+
+    def attach_health(self, watchdog, supervisor=None) -> None:
+        """Arm the self-healing loop: ``watchdog`` (HealthWatchdog)
+        classifies replicas on every ``pump``; ``supervisor``
+        (ReplicaSupervisor, optional) restarts the DEAD ones so the
+        fleet returns to N.  A single-replica router without a restart-
+        enabled supervisor is rejected loudly — its only possible DEAD
+        verdict would declare an unrecoverable outage, which is a
+        monitoring wish, not a healing loop."""
+        restart_on = (supervisor is not None
+                      and supervisor.restart_enabled)
+        if len(self.replicas) == 1 and not restart_on:
+            raise ValueError(
+                "watchdog on a single-replica router without restart: a "
+                "DEAD verdict could neither fail over nor rejoin (attach "
+                "a restart-enabled ReplicaSupervisor or add replicas)")
+        if supervisor is not None:
+            supervisor.bind(self)   # validates disjoint submeshes
+        self.health = watchdog
+        self.supervisor = supervisor
+        for rid, replica in self.replicas.items():
+            watchdog.register(rid)
+            engine = getattr(replica.backend, "engine", None)
+            if engine is not None:
+                engine._hb_stamp = True   # clock-stamp tick heartbeats
+
+    def _heal(self) -> None:
+        """Top of every ``pump``: probe, then heal each newly-DEAD
+        replica — quarantine its poison runs, fail it over (the existing
+        ``fail_replica`` semantics, now triggered in-tree), and restart
+        it when a restart-enabled supervisor is attached."""
+        sup = self.supervisor
+        restart_on = sup is not None and sup.restart_enabled
+        for rid in self.health.probe(self):
+            # poison-run quarantine BEFORE failover: a run that keeps
+            # sinking its replica must not be re-started a K+1th time
+            for ghandle in self._orphans(rid):
+                deaths = self._deaths.get(ghandle, 0) + 1
+                self._deaths[ghandle] = deaths
+                if deaths >= self.quarantine_after:
+                    self._quarantine(ghandle, rid, deaths)
+            if restart_on and len(self.alive_ids()) <= 1:
+                # last alive: fail_replica would refuse (an outage) but
+                # with restart the outage is recoverable — rebuild the
+                # corpse in place, then re-start its orphans on the
+                # fresh incarnation
+                self._restart_in_place(rid)
+            else:
+                self.fail_replica(rid)
+                if restart_on:
+                    sup.restart(rid)
+
+    def _quarantine(self, ghandle: int, rid: int, deaths: int) -> None:
+        """Settle a poison run FAILED with a named error.  The result
+        rides the next ``pump``'s dict, so serve/api.py maps it to
+        FAILED and journals ``run_settle`` exactly like any backend
+        failure — recovery replay agrees with the live outcome."""
+        loc = self._handle_map.pop(ghandle, None)
+        self._runs.pop(ghandle, None)
+        self._deaths.pop(ghandle, None)
+        if loc is not None:
+            self._local.pop(loc, None)
+            self.replicas[loc[0]].backend.cancel(loc[1])
+        self._quarantined_results[ghandle] = BackendResult(
+            text="", completion_tokens=0,
+            error=(f"quarantined: replica died {deaths} times with this "
+                   f"run in flight (poison run, quarantine_after="
+                   f"{self.quarantine_after})"))
+        self.quarantined += 1
+        METRICS.inc("cluster.quarantined_runs")
+        obs_trace.event("cluster.quarantine", run=ghandle, replica=rid,
+                        deaths=deaths)
+        log.warning("run %d quarantined after %d fatal incarnations "
+                    "(replica %d)", ghandle, deaths, rid)
+
+    def _restart_in_place(self, rid: int) -> None:
+        """Last-alive heal path: take the corpse out without the
+        last-alive refusal, restart it on its submesh, then re-start its
+        orphans on the fresh incarnation (same global handles — the same
+        contract as ``fail_replica``, minus survivors)."""
+        replica = self.replicas[rid]
+        replica.alive = False
+        orphans = self._orphans(rid)
+        for ghandle in orphans:
+            _, lhandle = self._handle_map[ghandle]
+            self._local.pop((rid, lhandle), None)
+            replica.backend.cancel(lhandle)
+        for session in [s for s, r in self._affinity.items() if r == rid]:
+            del self._affinity[session]
+        self.supervisor.restart(rid)
+        for ghandle in orphans:
+            prompt, opts = self._runs[ghandle]
+            new_rid = self._pick(opts.session, admit=False)
+            with inject.readmission():
+                new_lhandle = self.replicas[new_rid].backend.start(prompt,
+                                                                   opts)
+            self._handle_map[ghandle] = (new_rid, new_lhandle)
+            self._local[(new_rid, new_lhandle)] = ghandle
+        self.failovers += 1
+        METRICS.inc("cluster.failovers")
+        obs_trace.event("cluster.failover", replica=rid,
+                        kind="restart-in-place", migrated=len(orphans),
+                        alive=len(self.alive_ids()))
+        log.warning("replica %d restarted in place: %d runs re-started "
+                    "on the fresh incarnation", rid, len(orphans))
+
     # -------------------------------------------------------------- routing
 
     def _has_capacity(self, replica: Replica, priority: int = 1) -> bool:
@@ -126,16 +260,31 @@ class ClusterRouter:
         alive = self.alive_ids()
         if not alive:
             raise RouterAdmissionError("no alive replica")
+        # route around SUSPECT replicas (cluster/health.py) while any
+        # fully-ALIVE replica exists — new work must not pile onto a
+        # replica the watchdog already distrusts; if EVERY replica is
+        # suspect, keep serving (a stall beats an outage)
+        suspect = (set() if self.health is None
+                   else {rid for rid in alive
+                         if self.health.is_suspect(rid)})
         if session:
             pinned = self._affinity.get(session)
             if pinned is not None and not self.replicas[pinned].alive:
                 pinned = None               # re-pin below
+            if (pinned is not None and pinned in suspect
+                    and len(suspect) < len(alive)):
+                del self._affinity[session]   # pin follows to a healthy
+                pinned = None                 # replica picked below
             if pinned is not None and (not admit or self._has_capacity(
                     self.replicas[pinned], priority)):
                 return pinned
         open_ = [rid for rid in alive
                  if not admit or self._has_capacity(self.replicas[rid],
                                                     priority)]
+        if suspect and open_:
+            healthy = [rid for rid in open_ if rid not in suspect]
+            if healthy:
+                open_ = healthy
         if not open_:
             raise RouterAdmissionError(
                 f"all {len(alive)} alive replicas at inflight cap "
@@ -164,8 +313,15 @@ class ClusterRouter:
 
     def pump(self) -> Dict[int, BackendResult]:
         results: Dict[int, BackendResult] = {}
+        if self.health is not None:
+            self._heal()
+            if self._quarantined_results:
+                results.update(self._quarantined_results)
+                self._quarantined_results.clear()
         for rid, replica in self.replicas.items():
-            if not replica.alive:
+            if not replica.alive or replica.wedged:
+                # wedged: the worker process is gone — nothing to pump,
+                # no beat; the watchdog detects it by the silence
                 continue
             # mirror the router's view into the replica engine before its
             # tick, so this tick's TickSample carries this tick's load
@@ -181,7 +337,12 @@ class ClusterRouter:
                     continue
                 self._handle_map.pop(ghandle, None)
                 self._runs.pop(ghandle, None)
+                self._deaths.pop(ghandle, None)
                 results[ghandle] = res
+            if self.health is not None:
+                self.health.beat(rid, ticks=(engine.heartbeat
+                                             if engine is not None
+                                             else None))
         return results
 
     def busy(self, handle: int) -> bool:
@@ -190,6 +351,8 @@ class ClusterRouter:
     def cancel(self, handle: int) -> None:
         loc = self._handle_map.pop(handle, None)
         self._runs.pop(handle, None)
+        self._deaths.pop(handle, None)
+        self._quarantined_results.pop(handle, None)
         if loc is None:
             return
         self._local.pop(loc, None)
@@ -245,8 +408,11 @@ class ClusterRouter:
         for ghandle in orphans:
             prompt, opts = self._runs[ghandle]
             new_rid = self._pick(opts.session, admit=False)
-            new_lhandle = self.replicas[new_rid].backend.start(prompt,
-                                                               opts)
+            # a re-admission, not a new run: the logical run drew its
+            # SITE_BACKEND fault at its FIRST start (see inject.readmission)
+            with inject.readmission():
+                new_lhandle = self.replicas[new_rid].backend.start(prompt,
+                                                                   opts)
             self._handle_map[ghandle] = (new_rid, new_lhandle)
             self._local[(new_rid, new_lhandle)] = ghandle
         self.failovers += 1
@@ -313,7 +479,8 @@ class ClusterRouter:
             new_rid = min(alive,
                           key=lambda r: (self.replicas[r].queue_depth(),
                                          r))
-            nl = self.replicas[new_rid].backend.start(prompt, opts)
+            with inject.readmission():
+                nl = self.replicas[new_rid].backend.start(prompt, opts)
             self._handle_map[ghandle] = (new_rid, nl)
             self._local[(new_rid, nl)] = ghandle
         for session in [s for s, r in self._affinity.items() if r == rid]:

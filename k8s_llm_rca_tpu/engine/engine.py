@@ -476,6 +476,15 @@ class EngineBase:
     # serve/api.py
     clock = None
     _deadlines: Optional[Dict[int, float]] = None
+    # liveness heartbeat (cluster/health.py): ``heartbeat`` is the
+    # monotonic tick serial every ``step`` bumps — probe-count liveness
+    # stays deterministic under a frozen VirtualClock.  ``heartbeat_t``
+    # is the clock stamp of the latest tick, taken only when a watchdog
+    # registered this engine (``_hb_stamp``), keeping the unwatched hot
+    # path to one falsy check.
+    heartbeat: int = 0
+    heartbeat_t: float = 0.0
+    _hb_stamp: bool = False
 
     def _now(self) -> float:
         if self.clock is not None:
@@ -1075,8 +1084,12 @@ class EngineBase:
         scheduled fault, run the subclass tick body (``_tick``), and —
         only when a tracer is active — wrap the tick in an
         ``engine.tick`` span and record a TickSample of the scheduler/
-        pool gauges.  The untraced, disarmed hot path pays exactly two
-        module-slot identity checks."""
+        pool gauges.  The untraced, disarmed, unwatched hot path pays
+        exactly two module-slot identity checks plus the heartbeat bump
+        (one int add and one falsy check)."""
+        self.heartbeat += 1                    # liveness tick serial
+        if self._hb_stamp:                     # unwatched cost: this check
+            self.heartbeat_t = self._now()
         if inject._ARMED is not None:          # disarmed cost: this check
             self._tick_fault()
         tr = obs_trace._ACTIVE

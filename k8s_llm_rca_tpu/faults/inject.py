@@ -105,6 +105,24 @@ def armed(plan: FaultPlan):
         disarm()
 
 
+@contextlib.contextmanager
+def readmission():
+    """Suppress injection polls for a re-admission of an ALREADY-admitted
+    run (cluster failover / drain re-starts an orphan's ``(prompt, opts)``
+    on a survivor).  A logical run draws its admission fault exactly once,
+    at its first ``start``: re-polling on failover would let the re-run
+    draw a DIFFERENT fault than the original admission (breaking the
+    byte-identical-failover contract whenever SITE_BACKEND is armed) and
+    would shift every later draw's poll index in the plan snapshot, so
+    kill-and-heal reports could never match the unkilled run."""
+    global _ARMED
+    plan, _ARMED = _ARMED, None
+    try:
+        yield
+    finally:
+        _ARMED = plan
+
+
 def apply_query_fault(fault: Fault, plan: FaultPlan,
                       run: Callable[[], List[Any]]) -> List[Any]:
     """Apply a graph-query fault: raise, degrade, or distort the rows the

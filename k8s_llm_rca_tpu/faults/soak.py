@@ -96,11 +96,17 @@ def _build_oracle_service(run_timeout_s: float, clock, journal=None):
 
 
 def _build_cluster_service(run_timeout_s: float, clock, journal=None,
-                           n_replicas: int = 2, oracle: bool = False):
+                           n_replicas: int = 2, oracle: bool = False,
+                           selfheal: bool = False, health_policy=None):
     """N-replica serving behind a ClusterRouter (cluster/).  ``oracle``
     replicas are scripted backends — the cheap mode the 100-incident
     replica-kill soak runs on (tier-1 budget); engine replicas reuse the
     single-engine soak's TINY config, sharded onto disjoint submeshes.
+
+    ``selfheal``: arm the self-healing loop (cluster/health.py) — a
+    HealthWatchdog on the soak's VirtualClock plus a restart-enabled
+    ReplicaSupervisor, so wedged replicas are detected, failed over and
+    rejoined in-tree with no external ``fail_replica`` call.
 
     Returns ``(service, engines, factory, router)`` — ``engines`` is the
     per-replica engine list ([] for oracle replicas) so the caller can
@@ -115,7 +121,8 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
         from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
 
         tok = get_tokenizer()
-        replicas = [Replica(i, OracleBackend(tok))
+        replicas = [Replica(i, OracleBackend(tok),
+                            rebuild=lambda tok=tok: OracleBackend(tok))
                     for i in range(n_replicas)]
         engines = []
     else:
@@ -133,6 +140,13 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
             n_replicas, seed=0, use_kernel=False)
         engines = [r.backend.engine for r in replicas]
     router = ClusterRouter(replicas)
+    if selfheal:
+        from k8s_llm_rca_tpu.cluster import (
+            HealthWatchdog, ReplicaSupervisor,
+        )
+
+        router.attach_health(HealthWatchdog(health_policy, clock=clock),
+                             ReplicaSupervisor())
     factory = lambda: router                           # noqa: E731
     return (AssistantService(router, run_timeout_s=run_timeout_s,
                              clock=clock, journal=journal),
@@ -147,7 +161,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    durable_dir: Optional[str] = None,
                    supervisor: Optional[Any] = None,
                    cluster_replicas: int = 2,
-                   killer: Optional[Any] = None) -> Dict[str, Any]:
+                   killer: Optional[Any] = None,
+                   selfheal: bool = False) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
 
@@ -186,6 +201,18 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     runs its OWN FaultPlan, so the armed plan's poll counters (and hence
     the report) match the uninterrupted run exactly; crash/recovery stats
     live on the supervisor object, not in the report.
+
+    ``selfheal`` (cluster modes only): arm the self-healing loop
+    (cluster/health.py).  A ``killer`` then *wedges* its victims
+    instead of calling ``fail_replica`` — the watchdog detects the
+    silence over subsequent pumps, fails the corpse over in-tree and
+    the supervisor rejoins a fresh incarnation, so the fleet repeatedly
+    returns to full strength (the kill-and-heal soak: report bytes
+    still match the unkilled run, and heal stats live on
+    ``router.health`` / ``router.supervisor``, never in the report).
+    After the sweep the router is pumped a few extra (plan-free) times
+    so a wedge landed at the last boundary still heals before the
+    engine-clean check.
     """
     from k8s_llm_rca_tpu.config import RCAConfig
     from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
@@ -225,8 +252,13 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
         service, engines, factory, router = _build_cluster_service(
             run_timeout_s, clock, journal,
             n_replicas=cluster_replicas,
-            oracle=(backend == "cluster-oracle"))
+            oracle=(backend == "cluster-oracle"),
+            selfheal=selfheal)
         engine = None   # "engine_clean" is per-replica below
+    elif selfheal:
+        raise ValueError("selfheal requires a cluster backend: the "
+                         "watchdog/supervisor loop heals replicas, not "
+                         "a single engine")
     else:
         service, engine, factory = _build_oracle_service(
             run_timeout_s, clock, journal)
@@ -307,6 +339,20 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                 # plan; the router fails the victim over in place)
                 killer.checkpoint()
 
+        if router is not None and router.health is not None:
+            # kill-and-heal drain: a wedge landed at the LAST incident
+            # boundary has not accrued its missed probes yet — keep
+            # pumping (idle replicas: no armed-plan polls) until the
+            # watchdog's verdict lands and the supervisor returns the
+            # fleet to N.  Bounded: one wedge needs at most
+            # hung_tick_threshold probes plus the healing pump.
+            budget = router.health.policy.hung_tick_threshold + 2
+            for _ in range(budget):
+                if all(r.alive and not r.wedged
+                       for r in router.replicas.values()):
+                    break
+                router.pump()
+
     if journal is not None:
         # close the CURRENT journal (a supervised crash may have swapped
         # in a reopened one on the same path)
@@ -330,6 +376,12 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     }
     if tracer is not None:
         report["flight"] = tracer.flight_summary()
+    if router is not None and engines:
+        # restarts swap fresh engines into the replicas; the clean check
+        # must look at the CURRENT incarnations (the corpses were cancel-
+        # drained through the failover path)
+        engines = [r.backend.engine for r in router.replicas.values()
+                   if getattr(r.backend, "engine", None) is not None]
     if engines:
         # the chaos run must leave EVERY engine clean — killed replicas
         # included (failover cancels through the normal retire path, so a
@@ -503,4 +555,131 @@ def run_saturation_scenario(n_replicas: int = 2, max_inflight: int = 2,
         "completed": sum(1 for i, h in handles.items()
                          if results.get(h) is not None
                          and results[h].error is None),
+    }
+
+
+def poisson_arrivals(seed: int, rate_per_s: float, n: int) -> List[float]:
+    """Seeded exponential inter-arrival gaps, cumulated to absolute
+    arrival offsets — the open-loop schedule (arrivals never wait on
+    completions, ROADMAP item 4).  Pure function of ``(seed,
+    rate_per_s, n)``; stdlib Mersenne, so byte-stable across hosts."""
+    if rate_per_s <= 0.0:
+        raise ValueError(f"rate_per_s must be > 0, got {rate_per_s}")
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    import random
+
+    rng = random.Random(seed)
+    t, out = 0.0, []
+    for _ in range(n):
+        t += rng.expovariate(rate_per_s)
+        out.append(round(t, 9))
+    return out
+
+
+def run_open_loop_soak(seed: int = 0, rate_per_s: float = 200.0,
+                       n_runs: int = 24, n_replicas: int = 2,
+                       selfheal: bool = False,
+                       killer: Optional[Any] = None,
+                       run_timeout_s: float = 30.0,
+                       tick_s: float = 0.005,
+                       durable_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Open-loop Poisson traffic through serve/api.py: seeded
+    exponential inter-arrivals feed ``create_run`` at ``rate_per_s``
+    regardless of completions, and the report carries p50/p99
+    time-to-report on the VirtualClock (each pump advances ``tick_s``,
+    so latency is a deterministic function of pump counts — the
+    measured-wall twin lives in bench.py).
+
+    Composable with the kill-and-heal machinery for the SRE-storm
+    scenario: ``killer`` (faults.supervisor.ReplicaKiller) is polled
+    exactly once per ARRIVAL on its own FaultPlan — with ``selfheal``
+    the victims are wedged and the watchdog/supervisor loop heals the
+    fleet while the storm keeps arriving.  Kill/heal stats stay on the
+    killer/router objects; the report is a pure function of its
+    arguments.
+    """
+    clock = VirtualClock()
+    journal = None
+    if durable_dir is not None:
+        import os
+
+        from k8s_llm_rca_tpu.serve.journal import RunJournal
+
+        os.makedirs(durable_dir, exist_ok=True)
+        journal = RunJournal(os.path.join(durable_dir, "openloop.wal"))
+    service, _, _, router = _build_cluster_service(
+        run_timeout_s, clock, journal, n_replicas=n_replicas,
+        oracle=True, selfheal=selfheal)
+    if killer is not None:
+        killer.router = router
+    from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS
+    from k8s_llm_rca_tpu.serve.api import RunStatus
+    from k8s_llm_rca_tpu.serve.backend import GenOptions
+
+    asst = service.create_assistant(
+        "You are an SRE root-cause analyst.", "openloop",
+        gen=GenOptions(max_new_tokens=64))
+    arrivals = poisson_arrivals(seed, rate_per_s, n_runs)
+    pending = list(enumerate(arrivals))
+    live: Dict[str, tuple] = {}               # run id -> (i, arrival_t)
+    rows: List[Dict[str, Any]] = []
+    while pending or live:
+        now = clock.time()
+        if pending and pending[0][1] <= now:
+            i, t_arr = pending.pop(0)
+            thread = service.create_thread()
+            service.add_message(
+                thread.id, INCIDENTS[i % len(INCIDENTS)].message)
+            run = service.create_run(thread.id, asst.id)
+            live[run.id] = (i, t_arr)
+            if killer is not None:
+                # arrival boundary: the kill schedule is a pure function
+                # of (killer plan, arrival index) — same discipline as
+                # the incident-boundary poll in run_chaos_soak
+                killer.checkpoint()
+            continue
+        service._pump()
+        now = clock.time()
+        for run_id in [r for r in live
+                       if service.runs[r].status in RunStatus.TERMINAL]:
+            i, t_arr = live.pop(run_id)
+            run = service.runs[run_id]
+            rows.append({"i": i, "status": run.status,
+                         "ttr_s": round(now - t_arr, 9)})
+        if pending and not live:
+            clock.sleep(max(0.0, pending[0][1] - now))  # idle: jump ahead
+        else:
+            clock.sleep(tick_s)
+    if router.health is not None:
+        budget = router.health.policy.hung_tick_threshold + 2
+        for _ in range(budget):      # heal a storm-tail wedge (see
+            if all(r.alive and not r.wedged   # run_chaos_soak drain)
+                   for r in router.replicas.values()):
+                break
+            router.pump()
+    if journal is not None:
+        live_journal = getattr(service, "_journal", None)
+        if live_journal is not None:
+            live_journal.close()
+    rows.sort(key=lambda r: r["i"])
+    ttrs = sorted(r["ttr_s"] for r in rows)
+
+    def _pct(q: float) -> Optional[float]:
+        if not ttrs:
+            return None
+        return round(ttrs[min(len(ttrs) - 1, int(q * len(ttrs)))], 9)
+
+    return {
+        "seed": seed, "rate_per_s": rate_per_s, "n_runs": n_runs,
+        "n_replicas": n_replicas, "selfheal": bool(selfheal),
+        "outcomes": rows,
+        "completed": sum(1 for r in rows
+                         if r["status"] == RunStatus.COMPLETED),
+        "failed": sum(1 for r in rows
+                      if r["status"] == RunStatus.FAILED),
+        "p50_ttr_s": _pct(0.50),
+        "p99_ttr_s": _pct(0.99),
+        "virtual_elapsed_s": round(clock.time(), 6),
+        "fleet_alive": len(router.alive_ids()),
     }

@@ -141,14 +141,26 @@ class ReplicaKiller:
     boundary on both outcome paths, so its kill schedule is a pure
     function of (plan, n_incidents).
 
-    On a scheduled "crash" fault it hard-kills one alive replica through
-    ``router.fail_replica`` — process-kill semantics: the replica's
-    device KV is treated as gone and its in-flight runs re-start from
-    their recorded prompts on survivors (greedy decode makes the final
-    outputs identical).  The victim is chosen deterministically from the
-    alive list by the fault's poll index.  The last alive replica is
-    never killed (the router would refuse loudly; a cluster soak is a
-    failover proof, not an outage proof).
+    On a scheduled "crash" fault it kills one alive replica — process-
+    kill semantics: the replica's device KV is treated as gone and its
+    in-flight runs re-start from their recorded prompts on survivors
+    (greedy decode makes the final outputs identical).  The victim is
+    chosen deterministically from the alive list by the fault's poll
+    index.  HOW it kills depends on the router:
+
+    - plain router: ``router.fail_replica`` directly (PR 6 semantics —
+      the kill and the failover are one external call);
+    - self-healing router (``attach_health`` armed): the victim is
+      *wedged* (``Replica.wedge`` — the process dies, nobody tells the
+      router) and the watchdog must detect the silence, fail over and,
+      with a restart-enabled ``ReplicaSupervisor``, rejoin a fresh
+      incarnation — the kill-and-heal soak proves all of that happens
+      with NO external ``fail_replica`` call.
+
+    The last alive replica is killed only when a restart-enabled
+    supervisor is attached (the fleet provably recovers); otherwise the
+    kill is skipped, preserving the original refusal — a cluster soak
+    without restart is a failover proof, not an outage proof.
 
     ``router`` may be bound after construction (``killer.router = r``) —
     ``run_chaos_soak`` builds the router itself and binds the killer to
@@ -172,14 +184,23 @@ class ReplicaKiller:
                         inject.SITE_REPLICA)
             return None
         alive = self.router.alive_ids()
-        if len(alive) <= 1:
-            log.warning("replica kill skipped: %d replica(s) alive",
-                        len(alive))
+        sup = getattr(self.router, "supervisor", None)
+        restart_on = sup is not None and getattr(sup, "restart_enabled",
+                                                 False)
+        if len(alive) <= 1 and not restart_on:
+            log.warning("replica kill skipped: %d replica(s) alive and "
+                        "no restart-enabled supervisor", len(alive))
             return None
         victim = alive[fault.index % len(alive)]
-        self.router.fail_replica(victim)
+        if getattr(self.router, "health", None) is not None:
+            # self-healing cluster: the kill is a wedge — the process
+            # dies silently and the watchdog owns detection, failover
+            # and restart (no external fail_replica call)
+            self.router.replicas[victim].wedge()
+        else:
+            self.router.fail_replica(victim)
         self.kills.append(victim)
         METRICS.inc("faults.replica_kills")
-        log.warning("replica kill #%d: replica %d failed over (%d alive)",
+        log.warning("replica kill #%d: replica %d killed (%d alive)",
                     len(self.kills), victim, len(self.router.alive_ids()))
         return victim

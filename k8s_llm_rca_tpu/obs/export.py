@@ -300,6 +300,19 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
                        "replica")
         for rid in sorted(occs):
             fam_o.add(occs[rid], labels=f'{{replica="{rid}"}}')
+        health = getattr(router, "health", None)
+        if health is not None:
+            # watchdog verdict per replica, numerically encoded so the
+            # dashboard can alert on max() (the cluster.replica_restarts
+            # / cluster.quarantined_runs counters ride the Metrics store
+            # as _total families like every other cluster counter)
+            code = {"alive": 0, "suspect": 1, "dead": 2}
+            fam_h = family(f"{_PREFIX}cluster_replica_health", "gauge",
+                           "watchdog verdict per replica "
+                           "(0=ALIVE 1=SUSPECT 2=DEAD)")
+            for rid in sorted(router.replicas):
+                fam_h.add(code.get(health.state(rid), 0),
+                          labels=f'{{replica="{rid}"}}')
 
     return "\n".join(families[n].render()
                      for n in sorted(families)) + "\n"

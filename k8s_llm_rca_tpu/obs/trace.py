@@ -72,6 +72,14 @@ SITES = frozenset({
     # cluster layer (cluster/router.py)
     "cluster.route",
     "cluster.failover",
+    # self-healing (cluster/health.py): watchdog verdict transitions,
+    # supervisor rejoin, poison-run quarantine, and the MTTD/MTTR spans
+    # measured on the watchdog's injectable clock
+    "cluster.health",
+    "cluster.restart",
+    "cluster.quarantine",
+    "cluster.mttd",
+    "cluster.mttr",
     # graph layer
     "graph.query",
     # rca pipeline stages

@@ -513,6 +513,38 @@ class TestSiteCoverage:
         assert {"engine.spill", "engine.restore"} \
             <= tr_spill.emitted_names()
 
+        # (6) self-heal sites: wedge a replica on a watchdog-armed echo
+        # cluster — SUSPECT/DEAD verdicts, poison-run quarantine (K=1),
+        # supervisor restart and the MTTD/MTTR spans all fire
+        # (cluster/health.py)
+        from k8s_llm_rca_tpu.cluster import (
+            HealthPolicy, HealthWatchdog, ReplicaSupervisor,
+        )
+
+        tr_heal = Tracer(clock=VirtualClock())
+        tracers.append(tr_heal)
+        with obs_trace.tracing(tr_heal):
+            heal_router = ClusterRouter(
+                [Replica(0, EchoBackend(tok, delay_pumps=10 ** 9),
+                         rebuild=lambda: EchoBackend(tok)),
+                 Replica(1, EchoBackend(tok, delay_pumps=10 ** 9),
+                         rebuild=lambda: EchoBackend(tok))],
+                quarantine_after=1)
+            heal_router.attach_health(
+                HealthWatchdog(HealthPolicy(miss_budget=1,
+                                            hung_tick_threshold=2),
+                               clock=VirtualClock()),
+                ReplicaSupervisor())
+            h_heal = heal_router.start("node notready",
+                                       GenOptions(session="s"))
+            heal_router.replicas[heal_router._handle_map[h_heal][0]].wedge()
+            heal_res = {}
+            for _ in range(6):
+                heal_res.update(heal_router.pump())
+            assert "quarantined" in heal_res[h_heal].error
+        assert {"cluster.health", "cluster.restart", "cluster.quarantine",
+                "cluster.mttd", "cluster.mttr"} <= tr_heal.emitted_names()
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:
