@@ -99,7 +99,8 @@ EngineReplica = Replica
 def build_replicas(model_cfg, engine_cfg, n_replicas: int,
                    devices: Optional[Sequence[Any]] = None,
                    data: int = 1, seed: int = 0,
-                   meshes=None, **engine_kw) -> List[Replica]:
+                   meshes=None, prefix_store=None,
+                   **engine_kw) -> List[Replica]:
     """N engine replicas on disjoint submeshes, one shared param init.
 
     ``meshes``: pre-carved submeshes (else ``carve_replica_meshes`` runs
@@ -108,6 +109,14 @@ def build_replicas(model_cfg, engine_cfg, n_replicas: int,
     submeshes the TINY head layout cannot shard are rejected loudly
     before any device work.  ``engine_kw`` forwards to ``make_engine``
     (e.g. ``use_kernel=False`` on the CPU test mesh).
+
+    ``prefix_store``: one SHARED ``engine.prefix.PrefixStore`` handed to
+    every replica's engine (docs/cluster.md "warm-start"): pages any
+    replica demotes (or ``flush_prefix_store``-publishes) become L1/L2
+    hits on every other, so a new replica — and a supervisor-restarted
+    incarnation, which rides the same ``engine_kw`` through the
+    ``rebuild`` recipe below — warm-starts by h2d page promotion instead
+    of re-prefilling the fleet's shared prompt preambles.
     """
     import jax
 
@@ -127,6 +136,8 @@ def build_replicas(model_cfg, engine_cfg, n_replicas: int,
     for mesh in meshes:
         validate_replica_mesh(mesh, model_cfg, engine_cfg)
 
+    if prefix_store is not None:
+        engine_kw = dict(engine_kw, prefix_store=prefix_store)
     tok = engine_kw.pop("tokenizer", None)
     if tok is None:
         from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer

@@ -452,6 +452,16 @@ class ClusterRouter:
                 "drain_replica needs engine replicas on both sides "
                 "(snapshot_sequences/adopt_sequences); for scripted "
                 "replicas use fail_replica (re-start semantics)")
+        # restore-by-pages seam (docs/cluster.md "warm-start"): publish
+        # the drained engine's resident prefix pages into the shared
+        # PrefixStore BEFORE snapshotting, so the adopter's re-prefill
+        # of each migrated sequence promotes the shared preamble by h2d
+        # page writes (L1 hits) instead of re-burning prefill FLOPs —
+        # PR 3's "mostly-HIT re-prefill" upgraded to page restores.
+        # No-op (returns 0) without a store; the snapshot/adopt contract
+        # is unchanged either way.
+        if hasattr(engine, "flush_prefix_store"):
+            engine.flush_prefix_store()
         snap = engine.snapshot_sequences()
         seqs = list(snap.get("sequences", []))
         # snapshot order -> source local handles, global handles, opts

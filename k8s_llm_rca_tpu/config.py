@@ -249,6 +249,27 @@ class EngineConfig:
     # pp_mesh (pool layer axis stage-sharded) and on the contiguous
     # engine.
     max_spilled_pages: int = 0
+    # tiered prefix cache (paged engine only; engine/prefix.py
+    # ``PrefixStore``, docs/performance.md "tiered prefix cache"): when
+    # any knob is set, prefix-cache eviction DEMOTES page KV to a
+    # host-RAM store (one coalesced d2h gather, the same page-record
+    # layout as KV spill) instead of discarding it, and tier-aware
+    # ``match`` PROMOTES store hits back by h2d page writes — a warm
+    # miss costs a page copy, not a re-prefill.  ``prefix_host_pages``
+    # caps the host-RAM tier (L1).  ``prefix_disk_dir`` persists
+    # demoted pages to disk (L2) with the utils/wal.py atomic
+    # temp+fsync+replace recipe and CRC-verified load: a torn/corrupt
+    # entry is a silent cold miss, never a crash.  ``prefix_disk_pages``
+    # caps the disk tier (0 with a dir set = unbounded).  The store's
+    # budget is its OWN — spilled-run pages (``max_spilled_pages``) and
+    # cached prefix pages never share a cap.  Greedy byte-parity across
+    # cold-miss / L0 / L1 / L2 hits is guaranteed; excluded (loud
+    # ValueError) on cp_mesh (page axis sequence-sharded), pp_mesh
+    # (pool layer axis stage-sharded) and the contiguous engine,
+    # mirroring the spill exclusions.
+    prefix_host_pages: int = 0
+    prefix_disk_dir: Optional[str] = None
+    prefix_disk_pages: int = 0
 
 
 @dataclass(frozen=True)

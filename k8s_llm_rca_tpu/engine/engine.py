@@ -1146,6 +1146,14 @@ class EngineBase:
             spilled_pages=c.get("engine.spilled_pages", 0.0),
             restored_pages=c.get("engine.restored_pages", 0.0),
             deadline_expirations=c.get("engine.deadline_expirations", 0.0),
+            prefix_hits_l0=c.get("engine.prefix_hits_l0", 0.0),
+            prefix_hits_l1=c.get("engine.prefix_hits_l1", 0.0),
+            prefix_hits_l2=c.get("engine.prefix_hits_l2", 0.0),
+            prefix_demotions=c.get("engine.prefix_demotions", 0.0),
+            prefix_promoted_pages=c.get("engine.prefix_promoted_pages",
+                                        0.0),
+            prefix_bytes_restored=c.get("engine.prefix_bytes_restored",
+                                        0.0),
             queued_critical=g.get("queued_critical", 0),
             queued_normal=g.get("queued_normal", 0),
             queued_batch=g.get("queued_batch", 0),
@@ -1604,6 +1612,7 @@ class InferenceEngine(EngineBase):
         pp_stage_axis: str = "stage",
         sp: bool = False,
         draft_model=None,
+        prefix_store=None,
     ):
         """``draft_model``: optional (ModelConfig, params) of a small
         draft Llama (same vocabulary) — speculation then drafts with the
@@ -1661,6 +1670,15 @@ class InferenceEngine(EngineBase):
                 "the paged engine: the contiguous cache has no page pool "
                 "to spill from and never preempts.  Use paged=True "
                 "(PagedInferenceEngine) or max_spilled_pages=0")
+        if (engine_cfg.prefix_host_pages or engine_cfg.prefix_disk_dir
+                or engine_cfg.prefix_disk_pages or prefix_store is not None):
+            raise ValueError(
+                "the tiered prefix cache (prefix_host_pages / "
+                "prefix_disk_dir / prefix_disk_pages / a shared "
+                "prefix_store) requires the paged engine: the contiguous "
+                "cache has no page pool to demote prefix pages from or "
+                "promote them into.  Use paged=True "
+                "(PagedInferenceEngine) or leave the tier knobs unset")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],

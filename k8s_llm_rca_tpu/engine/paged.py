@@ -52,10 +52,16 @@ from k8s_llm_rca_tpu.ops.paged_attention import (
     paged_attention, paged_attention_quant, paged_attention_quant_sharded,
     paged_attention_sharded, paged_attention_xla,
 )
-from k8s_llm_rca_tpu.engine.prefix import PrefixCache
+from k8s_llm_rca_tpu.engine.prefix import (
+    CACHE_OWNER, PrefixCache, PrefixStore,
+)
 from k8s_llm_rca_tpu.ops.rope import rope_frequencies
 from k8s_llm_rca_tpu.runtime import profiling
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+from k8s_llm_rca_tpu.utils.pages import (
+    gather_pages, record_nbytes, records_compatible, restore_pages,
+    split_pages, stack_pages, suffix_bucket,
+)
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
 log = get_logger(__name__)
@@ -881,7 +887,7 @@ class PagedInferenceEngine(EngineBase):
                  cp_mode: str = "ring", ep_mesh=None, tp_mesh=None,
                  pp_mesh=None, pp_microbatches: Optional[int] = None,
                  pp_stage_axis: str = "stage", sp: bool = False,
-                 draft_model=None):
+                 draft_model=None, prefix_store: Optional[PrefixStore] = None):
         """``cp_mesh``: optional Mesh with a ``cp_seq_axis`` axis — prefill
         runs context-parallel over it (ring or Ulysses, as in the
         contiguous engine) and scatters the full-depth KV into pool pages.
@@ -1012,6 +1018,50 @@ class PagedInferenceEngine(EngineBase):
                     "must interleave with the GPipe microbatch schedule "
                     "deterministically on every process; serve PP engines "
                     "with max_spilled_pages=0 (free-and-re-prefill)")
+        tiered = bool(engine_cfg.prefix_host_pages
+                      or engine_cfg.prefix_disk_dir
+                      or engine_cfg.prefix_disk_pages
+                      or prefix_store is not None)
+        if tiered:
+            if engine_cfg.prefix_host_pages < 0:
+                raise ValueError(
+                    f"prefix_host_pages={engine_cfg.prefix_host_pages} "
+                    f"must be >= 0 (0 disables the host-RAM prefix tier)")
+            if engine_cfg.prefix_disk_pages < 0:
+                raise ValueError(
+                    f"prefix_disk_pages={engine_cfg.prefix_disk_pages} "
+                    f"must be >= 0 (0 with prefix_disk_dir = unbounded)")
+            if engine_cfg.prefix_disk_pages and not engine_cfg.prefix_disk_dir:
+                raise ValueError(
+                    f"prefix_disk_pages={engine_cfg.prefix_disk_pages} "
+                    f"needs prefix_disk_dir: the cap bounds a disk tier "
+                    f"that does not exist without a directory")
+            if not engine_cfg.prefix_cache:
+                raise ValueError(
+                    "the tiered prefix cache (prefix_host_pages / "
+                    "prefix_disk_dir / prefix_disk_pages / a shared "
+                    "prefix_store) requires prefix_cache=True: the tiers "
+                    "demote FROM and promote INTO the resident L0 chain "
+                    "— without it there is nothing to key pages by")
+            if cp_mesh is not None:
+                raise ValueError(
+                    "the tiered prefix cache is unsupported with cp_mesh: "
+                    "the CP pool's PAGE axis is sequence-sharded, so one "
+                    "logical page is not one host buffer — a demote "
+                    "gather / promote scatter would reshard the pool "
+                    "through host memory (and cp_mesh already requires "
+                    "prefix_cache=False); serve CP engines without the "
+                    "prefix tier knobs")
+            if pp_mesh is not None:
+                raise ValueError(
+                    "the tiered prefix cache is unsupported with pp_mesh: "
+                    "the pool's LAYER axis is stage-sharded (possibly "
+                    "across hosts over DCN), so demote d2h / promote h2d "
+                    "would issue cross-stage collectives that must "
+                    "interleave with the GPipe microbatch schedule "
+                    "deterministically on every process — the same "
+                    "physics as the max_spilled_pages exclusion; serve "
+                    "PP engines without the prefix tier knobs")
         self._cp_parts = 0
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
@@ -1163,8 +1213,25 @@ class PagedInferenceEngine(EngineBase):
         else:
             self.allocator = make_allocator(engine_cfg.num_pages,
                                             engine_cfg.native)
-        self.prefix_cache = (PrefixCache(self.allocator, self.page_size)
-                             if engine_cfg.prefix_cache else None)
+        # tiered prefix cache (docs/performance.md): a passed store is
+        # SHARED (cluster warm-start — build_replicas / supervisor
+        # restarts hand every incarnation the same one); otherwise the
+        # tier knobs build a private store.  The demote/promote hooks
+        # close over this engine's pool; ``count=self._count`` routes
+        # tier-hit counters into the TickSample/Prometheus mirrors.
+        self.prefix_store = prefix_store
+        if tiered and self.prefix_store is None:
+            self.prefix_store = PrefixStore(
+                host_pages=engine_cfg.prefix_host_pages,
+                disk_dir=engine_cfg.prefix_disk_dir,
+                disk_pages=engine_cfg.prefix_disk_pages)
+        self.prefix_cache = (
+            PrefixCache(self.allocator, self.page_size,
+                        store=self.prefix_store,
+                        demote=self._demote_prefix_pages,
+                        promote=self._promote_prefix_records,
+                        count=self._count)
+            if engine_cfg.prefix_cache else None)
 
         self.block_tables = np.full((b, self.pages_per_seq), TRASH_PAGE,
                                     np.int32)
@@ -2009,12 +2076,12 @@ class PagedInferenceEngine(EngineBase):
         cached_pages, n_cached = matched
         n_cp = len(cached_pages)
         rest = req.prompt_ids[n_cached:]
-        # cap the bucket at the table space left after the cached prefix
-        # (always >= len(rest): n_cached + len(rest) <= pages_per_seq * page)
-        bucket = min(self._bucket(len(rest)),
-                     (self.pages_per_seq - n_cp) * self.page_size)
+        # suffix bucket capped at the table space left after the cached
+        # prefix (utils/pages.py — one definition with _admit_chunked
+        # and _admit_spilled, so allocator state evolves identically)
+        bucket, n_pages = suffix_bucket(self._bucket, len(rest), n_cp,
+                                        self.page_size, self.pages_per_seq)
         assert len(rest) <= bucket, (len(rest), bucket)
-        n_pages = bucket // self.page_size
         try:
             # sequence-page indices n_cp..n_cp+n_pages-1 (partition-aligned
             # under the CP seq-sharded pool; plain allocation otherwise)
@@ -2100,9 +2167,8 @@ class PagedInferenceEngine(EngineBase):
         if len(rest) <= self.engine_cfg.prefill_chunk_budget:
             return self._admit(req, matched)
         n_cp = len(cached_pages)
-        bucket = min(self._bucket(len(rest)),
-                     (self.pages_per_seq - n_cp) * self.page_size)
-        n_pages = bucket // self.page_size
+        bucket, n_pages = suffix_bucket(self._bucket, len(rest), n_cp,
+                                        self.page_size, self.pages_per_seq)
         try:
             pages = self._alloc_seq_pages(range(n_cp, n_cp + n_pages),
                                           owner=req.seq_id)
@@ -2531,6 +2597,59 @@ class PagedInferenceEngine(EngineBase):
             st.seq_id, resumed_prompt, remaining, st.stop_strings,
             st.grammar, priority=st.priority), front=True)
 
+    def _demote_prefix_pages(self, pages: List[int]
+                             ) -> Optional[List[Dict[str, object]]]:
+        """PrefixCache demote hook: ONE coalesced d2h gather of resident
+        prefix pages (the same page-record layout ``_maybe_spill``
+        builds, utils/pages.py) split into per-page store entries.
+        Counted as ``engine.prefix_demotions`` per page.  The gather
+        never touches the spill budget: demoted PREFIX pages live in the
+        PrefixStore under its own prefix_host_pages/prefix_disk_pages
+        caps, while ``max_spilled_pages`` keeps governing spilled RUN
+        pages only."""
+        with profiling.annotate("engine.prefix_demote"):
+            rec = gather_pages(self.pool, self._fetch, pages)
+            self._count("engine.prefix_demotions", len(pages))
+            return split_pages(rec)
+
+    def _promote_prefix_records(self, recs: List[Dict[str, object]]
+                                ) -> Optional[List[int]]:
+        """PrefixCache promote hook: allocate fresh CACHE_OWNER pages and
+        h2d-scatter demoted records back (``_admit_spilled``'s restore
+        scatter via utils/pages.py).  Returns the page ids, or None —
+        treated as a cold miss by the tier-aware ``match`` — when the
+        records don't fit this engine's pool (a store shared across
+        engine configs) or the allocator has no room.  Allocation is
+        PLAIN (no evict-on-pressure): evicting L0 to promote L1 would
+        demote inside a match, churning pages for zero net gain."""
+        if not recs or not all(records_compatible(self.pool, r)
+                               for r in recs):
+            return None
+        try:
+            pages = self.allocator.alloc(len(recs), owner=CACHE_OWNER)
+        except OutOfPages:
+            return None
+        with profiling.annotate("engine.prefix_promote"):
+            rec = stack_pages(recs)
+            self.pool = restore_pages(self.pool, rec, pages)
+            self._count("engine.prefix_promoted_pages", len(pages))
+            self._count("engine.prefix_bytes_restored",
+                        record_nbytes(rec))
+        return pages
+
+    def flush_prefix_store(self, limit: Optional[int] = None) -> int:
+        """Publish resident prefix pages into the shared ``PrefixStore``
+        WITHOUT freeing them (one coalesced gather; already-stored
+        digests skipped) — the cluster warm-start seam: a replica
+        flushes before ``drain_replica`` snapshots it (and ahead of
+        planned restarts), so fresh/restarted replicas sharing the
+        store restore-by-pages instead of re-prefilling.  Returns the
+        number of pages copied; 0 without a store."""
+        if self.prefix_cache is None or self.prefix_store is None:
+            return 0
+        self._overlap_barrier()
+        return self.prefix_cache.flush_to_store(limit)
+
     def _maybe_spill(self, slot: int, st: _Active) -> bool:
         """Spill a preempted slot's written private KV pages to host
         buffers (ONE coalesced d2h gather) so the sequence later resumes
@@ -2577,16 +2696,10 @@ class PagedInferenceEngine(EngineBase):
                 "cur_token": int(self.cur_tokens[slot]),
             }
             if spill_idx:
-                idx = jnp.asarray(np.asarray(spill_idx, np.int32))
-                gathered = [jnp.take(self.pool.k, idx, axis=1),
-                            jnp.take(self.pool.v, idx, axis=1)]
-                if self.pool.quantized:
-                    gathered += [jnp.take(self.pool.k_scale, idx, axis=1),
-                                 jnp.take(self.pool.v_scale, idx, axis=1)]
-                host = self._fetch(*gathered)
-                rec["k"], rec["v"] = host[0], host[1]
-                if self.pool.quantized:
-                    rec["k_scale"], rec["v_scale"] = host[2], host[3]
+                # shared d2h page gather (utils/pages.py): the ONE
+                # coalesced fetch the prefix-demote hook also uses
+                rec.update(gather_pages(self.pool, self._fetch,
+                                        spill_idx))
             self._spilled[st.seq_id] = rec
             self._spilled_pages_total += len(spill_idx)
             self._count("engine.spilled_pages", len(spill_idx))
@@ -2610,26 +2723,17 @@ class PagedInferenceEngine(EngineBase):
         assert resume_len == len(req.prompt_ids), (resume_len,
                                                    len(req.prompt_ids))
         rest = resume_len - n_shared * ps
-        bucket = min(self._bucket(rest),
-                     (self.pages_per_seq - n_shared) * ps)
-        n_pages = bucket // ps
+        bucket, n_pages = suffix_bucket(self._bucket, rest, n_shared, ps,
+                                        self.pages_per_seq)
         pages = self._alloc_seq_pages(range(n_shared, n_shared + n_pages),
                                       owner=req.seq_id)
         n_spill = int(rec["n_pages"])
         with profiling.annotate("engine.restore"):
             if n_spill:
-                idx = jnp.asarray(np.asarray(pages[:n_spill], np.int32))
-                k = self.pool.k.at[:, idx].set(jnp.asarray(rec["k"]))
-                v = self.pool.v.at[:, idx].set(jnp.asarray(rec["v"]))
-                if self.pool.quantized:
-                    self.pool = self.pool._replace(
-                        k=k, v=v,
-                        k_scale=self.pool.k_scale.at[:, idx].set(
-                            jnp.asarray(rec["k_scale"])),
-                        v_scale=self.pool.v_scale.at[:, idx].set(
-                            jnp.asarray(rec["v_scale"])))
-                else:
-                    self.pool = self.pool._replace(k=k, v=v)
+                # shared h2d page scatter (utils/pages.py): the same
+                # restore the prefix-promote hook performs
+                self.pool = restore_pages(self.pool, rec,
+                                          pages[:n_spill])
             slot = self._free_slots.pop(0)
             table = np.full((self.pages_per_seq,), TRASH_PAGE, np.int32)
             table[:n_shared] = rec["shared_pages"]

@@ -1,5 +1,5 @@
 """Page-granular prefix cache: KV reuse across requests that share a prompt
-prefix.
+prefix — tiered HBM (L0) → host RAM (L1) → disk (L2).
 
 Why this exists: the reference's agent threads grow monotonically — every
 retry and every per-entity audit appends to one OpenAI thread whose full
@@ -27,6 +27,20 @@ Design:
   page whose key is already chained to a *different* page (a concurrent
   duplicate prefill) — that page stays private to its sequence.
 
+Tiers (EngineConfig.prefix_host_pages / prefix_disk_dir /
+prefix_disk_pages; docs/performance.md "tiered prefix cache"): with a
+``PrefixStore`` attached, ``evict`` DEMOTES page KV into the store (one
+coalesced d2h gather through the engine hook — the same page-record
+layout KV spill uses, utils/pages.py) before freeing, and ``match``
+extends past the resident chain into the store, PROMOTING hits back by
+h2d page writes.  Store entries are keyed by the same chained digests,
+so a promoted page is byte-identical to the page eviction demoted —
+greedy parity across cold / L0 / L1 / L2 holds through the already-
+trusted prefix-hit prefill path.  The store is shareable across engines
+(cluster/replica.py ``build_replicas(prefix_store=...)``): replicas and
+supervisor-restarted incarnations warm-start from pages their siblings
+demoted or ``flush_to_store`` published.
+
 The reference has no KV reuse of any kind (every run re-bills the full
 prompt, reference common/openai_generic_assistant.py:117-135); this is a
 TPU-native engine feature the build adds on top of the paged pool.
@@ -35,12 +49,18 @@ TPU-native engine feature the build adds on top of the paged pool.
 from __future__ import annotations
 
 import hashlib
+import os
 from collections import OrderedDict
-from typing import Dict, List, Sequence, Tuple
+from typing import (
+    Callable, Dict, List, Optional, Sequence, Tuple,
+)
 
 import numpy as np
 
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+from k8s_llm_rca_tpu.utils.pages import (
+    decode_page_record, encode_page_record,
+)
 
 log = get_logger(__name__)
 
@@ -66,17 +86,196 @@ def _page_keys(prompt_ids: Sequence[int], n_pages: int,
     return keys
 
 
+class PrefixStore:
+    """Host-RAM (L1) + disk (L2) tiers of demoted prefix-page KV.
+
+    Entries are per-page records (utils/pages.py layout, page axis length
+    1) keyed by the chain digest of the page they held.  L1 is an LRU
+    ``OrderedDict`` capped at ``host_pages``; overflow (and every put
+    when ``host_pages == 0``) lands on disk when ``disk_dir`` is set,
+    else is dropped (plain discard — exactly the pre-tier behavior).
+
+    Disk entries are written with the WAL atomic recipe (utils/wal.py
+    ``scan_wal``'s temp + fsync + ``os.replace``): a crash mid-write
+    leaves either no file or a whole file, and the CRC frame catches a
+    torn/corrupt one at load — ``get`` then answers None (silent cold
+    miss) and drops the entry, never raising.  A fresh store pointed at
+    an existing ``disk_dir`` re-indexes the surviving ``*.page`` files,
+    which is how a restarted process (or a new replica handed the same
+    directory) warm-starts across process death.
+
+    The store is engine-agnostic and shareable: it never touches a page
+    allocator or device memory — engines gather INTO it and scatter OUT
+    of it through their own hooks.  Single-threaded by design, like the
+    cluster pump that shares it.
+    """
+
+    def __init__(self, host_pages: int = 0,
+                 disk_dir: Optional[str] = None,
+                 disk_pages: int = 0):
+        if host_pages < 0:
+            raise ValueError(f"host_pages={host_pages} must be >= 0")
+        if disk_pages < 0:
+            raise ValueError(f"disk_pages={disk_pages} must be >= 0")
+        if disk_pages and not disk_dir:
+            raise ValueError(
+                f"disk_pages={disk_pages} needs disk_dir: the cap bounds "
+                f"a disk tier that does not exist without a directory")
+        self.host_pages = host_pages
+        self.disk_dir = disk_dir
+        self.disk_pages = disk_pages
+        self._l1: "OrderedDict[bytes, Dict[str, object]]" = OrderedDict()
+        self._l2: "OrderedDict[bytes, str]" = OrderedDict()
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+            # deterministic re-index order (sorted names, not mtime):
+            # LRU age across a restart is unknowable anyway, and sorted
+            # keeps which-entry-gets-capped a pure function of the set
+            for name in sorted(os.listdir(disk_dir)):
+                if name.endswith(".page"):
+                    try:
+                        key = bytes.fromhex(name[:-len(".page")])
+                    except ValueError:
+                        continue        # foreign file, not an entry
+                    self._l2[key] = os.path.join(disk_dir, name)
+
+    # ------------------------------------------------------------- stats
+
+    @property
+    def n_host(self) -> int:
+        return len(self._l1)
+
+    @property
+    def n_disk(self) -> int:
+        return len(self._l2)
+
+    def contains(self, key: bytes) -> bool:
+        """Cheap probe (no load, no LRU touch): either tier holds it."""
+        return key in self._l1 or key in self._l2
+
+    # --------------------------------------------------------------- put
+
+    def put(self, key: bytes, rec: Dict[str, object]) -> None:
+        """Admit one demoted page record under its chain digest.  L1
+        first; overflow demotes the LRU L1 entry to disk.  Re-putting a
+        present key only refreshes recency — the digest pins the bytes,
+        so rewriting them is pure waste."""
+        if key in self._l1:
+            self._l1.move_to_end(key)
+            return
+        if self.host_pages > 0:
+            self._l1[key] = rec
+            self._l1.move_to_end(key)
+            while len(self._l1) > self.host_pages:
+                old_key, old_rec = self._l1.popitem(last=False)
+                self._to_disk(old_key, old_rec)
+        else:
+            self._to_disk(key, rec)
+
+    def _to_disk(self, key: bytes, rec: Dict[str, object]) -> None:
+        """Persist one record as ``<digest hex>.page`` with the atomic
+        temp + fsync + ``os.replace`` recipe; without a ``disk_dir`` the
+        record is dropped (legacy discard).  A record too large for the
+        WAL frame is dropped too — persistence is best-effort, parity
+        never depends on it (a missing entry is just a cold miss)."""
+        if not self.disk_dir:
+            return
+        if key in self._l2:
+            self._l2.move_to_end(key)
+            return
+        path = os.path.join(self.disk_dir, key.hex() + ".page")
+        try:
+            frame = encode_page_record(rec)
+        except ValueError:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._l2[key] = path
+        while self.disk_pages and len(self._l2) > self.disk_pages:
+            self._drop_disk(*self._l2.popitem(last=False))
+
+    @staticmethod
+    def _drop_disk(key: bytes, path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass                        # already gone: cap still holds
+
+    # --------------------------------------------------------------- get
+
+    def get(self, key: bytes
+            ) -> Optional[Tuple[Dict[str, object], int]]:
+        """Fetch one record; returns ``(record, tier)`` with tier 1 (host
+        RAM) or 2 (disk), or None.  A disk hit is CRC-verified and
+        re-admitted to L1 (it may overflow another entry back to disk);
+        any torn/corrupt/missing file drops the index entry and answers
+        None — the caller re-prefills, exactly the cold path."""
+        rec = self._l1.get(key)
+        if rec is not None:
+            self._l1.move_to_end(key)
+            return rec, 1
+        path = self._l2.get(key)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            data = b""
+        rec = decode_page_record(data)
+        if rec is None:
+            self._l2.pop(key, None)
+            self._drop_disk(key, path)
+            log.warning("prefix store: corrupt/unreadable disk entry "
+                        "%s dropped (cold miss)", os.path.basename(path))
+            return None
+        self._l2.move_to_end(key)
+        if self.host_pages > 0:
+            # promote into L1 without re-writing the (present) disk copy
+            self._l1[key] = rec
+            while len(self._l1) > self.host_pages:
+                old_key, old_rec = self._l1.popitem(last=False)
+                self._to_disk(old_key, old_rec)
+        return rec, 2
+
+
 class PrefixCache:
     """Host-side index of shared prompt-prefix pages.
 
     The allocator stays the single owner-of-record of page ids; this class
     only re-tags ownership (seq <-> CACHE_OWNER via ``transfer``) and
     decides which refcount-0 pages to evict.
+
+    Tier hooks (wired by the paged engine when a ``PrefixStore`` is
+    attached; all None on a plain cache — behavior then is exactly the
+    pre-tier discard cache):
+
+    - ``demote(pages) -> per-page records | None``: ONE coalesced d2h
+      gather of resident pages (engine ``_demote_prefix_pages``);
+    - ``promote(records) -> page ids | None``: allocate CACHE_OWNER
+      pages and h2d-scatter the records into them (engine
+      ``_promote_prefix_records``); None means no room / incompatible
+      records — treated as a cold miss;
+    - ``count(name, value)``: the engine's ``_count`` so tier-hit
+      counters land in TickSample/Prometheus mirrors, not just METRICS.
     """
 
-    def __init__(self, allocator, page_size: int):
+    def __init__(self, allocator, page_size: int,
+                 store: Optional[PrefixStore] = None,
+                 demote: Optional[Callable] = None,
+                 promote: Optional[Callable] = None,
+                 count: Optional[Callable] = None):
         self.allocator = allocator
         self.page_size = page_size
+        self.store = store
+        self._demote = demote
+        self._promote = promote
+        self._count = count or (
+            lambda name, value=1.0: METRICS.inc(name, value))
         self._chain: Dict[bytes, int] = {}           # prefix digest -> page
         self._key_of: Dict[int, bytes] = {}          # page -> its digest
         self._ref: Dict[int, int] = {}               # page -> active users
@@ -95,7 +294,11 @@ class PrefixCache:
     # ------------------------------------------------------------- match
 
     def match(self, prompt_ids: Sequence[int]) -> Tuple[List[int], int]:
-        """Longest chained page-aligned prefix of ``prompt_ids``.
+        """Longest chained page-aligned prefix of ``prompt_ids``,
+        extended tier-aware: where the resident (L0) chain ends, store
+        hits for the NEXT keys are promoted back into fresh CACHE_OWNER
+        pages (one h2d scatter) and chained, so the caller sees one
+        contiguous shared run either way.
 
         Returns (pages, n_cached_tokens) and bumps each returned page's
         refcount.  Reuse is capped at the last FULL page strictly before
@@ -104,26 +307,64 @@ class PrefixCache:
         """
         P = self.page_size
         limit = (len(prompt_ids) - 1) // P          # pages eligible for reuse
+        keys = _page_keys(prompt_ids, limit, P)
         pages: List[int] = []
-        for key in _page_keys(prompt_ids, limit, P):
+        for key in keys:
             page = self._chain.get(key)
             if page is None:
                 break
             pages.append(page)
+        if pages:
+            self._count("engine.prefix_hits_l0", len(pages))
+        pages += self._match_store(keys[len(pages):])
         for p in pages:
             self._acquire(p)
         return pages, len(pages) * P
 
+    def _match_store(self, keys: Sequence[bytes]) -> List[int]:
+        """Promote the store's run of consecutive key hits past the
+        resident chain; returns the newly-chained page ids ([] without
+        a store / hooks / hits / room).  Promotion allocates WITHOUT
+        evicting (no demote reentrancy inside match); on OutOfPages the
+        suffix simply re-prefills — a performance miss, never an error.
+        """
+        if self.store is None or self._promote is None or not keys:
+            return []
+        recs: List[Dict[str, object]] = []
+        tiers: List[int] = []
+        for key in keys:
+            got = self.store.get(key)
+            if got is None:
+                break
+            recs.append(got[0])
+            tiers.append(got[1])
+        if not recs:
+            return []
+        new_pages = self._promote(recs)
+        if new_pages is None:
+            return []
+        assert len(new_pages) == len(recs)
+        for key, page, tier in zip(keys, new_pages, tiers):
+            self._chain[key] = page
+            self._key_of[page] = key
+            self._ref[page] = 0
+            self._lru[page] = None      # _acquire pops it right after
+            self._count(f"engine.prefix_hits_l{tier}", 1)
+        return new_pages
+
     def has_prefix(self, prompt_ids: Sequence[int]) -> bool:
         """Cheap non-acquiring probe: would ``match`` return any pages?
-        Checks only the first page's chain digest — enough for admission
-        grouping to route prefix-hitting requests to the single-admit
-        chunked path instead of redundantly prefilling them in a batch."""
+        Checks only the first page's chain digest (or its store
+        presence) — enough for admission grouping to route prefix-
+        hitting requests to the single-admit chunked path instead of
+        redundantly prefilling them in a batch."""
         P = self.page_size
         if (len(prompt_ids) - 1) // P < 1:
             return False
         for key in _page_keys(prompt_ids, 1, P):
-            return self._chain.get(key) is not None
+            if self._chain.get(key) is not None:
+                return True
+            return self.store is not None and self.store.contains(key)
         return False
 
     def _acquire(self, page: int) -> None:
@@ -185,15 +426,51 @@ class PrefixCache:
 
     def evict(self, n: int) -> int:
         """Free up to ``n`` least-recently-used refcount-0 pages back to
-        the allocator.  Returns how many were freed."""
-        freed = 0
-        while freed < n and self._lru:
-            page, _ = self._lru.popitem(last=False)
+        the allocator.  With a store attached the victims' KV is DEMOTED
+        first — one coalesced d2h gather of the whole victim set, then
+        one ``put`` per page — so what eviction used to destroy becomes
+        an L1/L2 entry a later ``match`` promotes back.  Returns how
+        many pages were freed (demotion never changes the count: the
+        allocator sees the identical free either way)."""
+        victims: List[int] = []
+        while len(victims) < n and self._lru:
+            victims.append(self._lru.popitem(last=False)[0])
+        if not victims:
+            return 0
+        if self.store is not None and self._demote is not None:
+            page_recs = self._demote(victims)
+            if page_recs is not None:
+                for page, rec in zip(victims, page_recs):
+                    self.store.put(self._key_of[page], rec)
+        for page in victims:
             key = self._key_of.pop(page)
             del self._chain[key]
             del self._ref[page]
             self.allocator.free([page], CACHE_OWNER)
-            freed += 1
-        if freed:
-            METRICS.inc("engine.prefix_evicted_pages", freed)
-        return freed
+        METRICS.inc("engine.prefix_evicted_pages", len(victims))
+        return len(victims)
+
+    # -------------------------------------------------------------- flush
+
+    def flush_to_store(self, limit: Optional[int] = None) -> int:
+        """Copy up to ``limit`` resident pages into the store WITHOUT
+        freeing them (refcounts, chain, LRU all untouched) — the warm-
+        start seam: a replica flushes before a drain/snapshot, or
+        periodically, so fresh/restarted replicas sharing the store
+        promote instead of re-prefilling.  Pages whose digest the store
+        already holds are skipped (the digest pins the bytes).  Returns
+        the number of pages copied."""
+        if self.store is None or self._demote is None:
+            return 0
+        pending = [(p, k) for p, k in self._key_of.items()
+                   if not self.store.contains(k)]
+        if limit is not None:
+            pending = pending[:limit]
+        if not pending:
+            return 0
+        page_recs = self._demote([p for p, _ in pending])
+        if page_recs is None:
+            return 0
+        for (_, key), rec in zip(pending, page_recs):
+            self.store.put(key, rec)
+        return len(pending)

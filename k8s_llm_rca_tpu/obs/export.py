@@ -116,6 +116,15 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                                     "queued_critical": s.queued_critical,
                                     "queued_normal": s.queued_normal,
                                     "queued_batch": s.queued_batch}})
+            events.append({**base, "name": "engine.prefix",
+                           "args": {"hits_l0": s.prefix_hits_l0,
+                                    "hits_l1": s.prefix_hits_l1,
+                                    "hits_l2": s.prefix_hits_l2,
+                                    "demotions": s.prefix_demotions,
+                                    "promoted_pages":
+                                    s.prefix_promoted_pages,
+                                    "bytes_restored":
+                                    s.prefix_bytes_restored}})
     # stable sort: equal-ts events keep recording order, so the document
     # is a pure function of the recording (byte-identity under VirtualClock)
     events.sort(key=lambda e: e["ts"])
@@ -267,6 +276,18 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
             counts.get("engine.restored_pages", 0.0)
         gauges["engine_deadline_expirations"] = \
             counts.get("engine.deadline_expirations", 0.0)
+        gauges["engine_prefix_hits_l0"] = \
+            counts.get("engine.prefix_hits_l0", 0.0)
+        gauges["engine_prefix_hits_l1"] = \
+            counts.get("engine.prefix_hits_l1", 0.0)
+        gauges["engine_prefix_hits_l2"] = \
+            counts.get("engine.prefix_hits_l2", 0.0)
+        gauges["engine_prefix_demotions"] = \
+            counts.get("engine.prefix_demotions", 0.0)
+        gauges["engine_prefix_promoted_pages"] = \
+            counts.get("engine.prefix_promoted_pages", 0.0)
+        gauges["engine_prefix_bytes_restored"] = \
+            counts.get("engine.prefix_bytes_restored", 0.0)
         # per-priority pending depth (guard: stub engines in tests queue
         # bare objects without a priority attribute)
         crit = norm = batch = 0

@@ -66,6 +66,18 @@ class TickSample:
     queued_critical: int = 0
     queued_normal: int = 0
     queued_batch: int = 0
+    # tiered prefix cache (docs/performance.md "tiered prefix cache"):
+    # cumulative match hits by tier in PAGES (L0 = resident HBM chain,
+    # L1 = host-RAM PrefixStore, L2 = disk), pages demoted store-ward by
+    # eviction/flush, pages promoted back by h2d restore, and the bytes
+    # those promotions scattered — the counters that prove a warm-start
+    # served pages instead of re-prefilling
+    prefix_hits_l0: float = 0.0
+    prefix_hits_l1: float = 0.0
+    prefix_hits_l2: float = 0.0
+    prefix_demotions: float = 0.0
+    prefix_promoted_pages: float = 0.0
+    prefix_bytes_restored: float = 0.0
 
 
 class TickTimeline:

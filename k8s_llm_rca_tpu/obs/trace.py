@@ -61,6 +61,11 @@ SITES = frozenset({
     # preemption and the h2d page restore that resumes the sequence
     "engine.spill",
     "engine.restore",
+    # tiered prefix cache (engine/paged.py hooks): eviction's d2h page
+    # demotion into the PrefixStore and the h2d promotion that serves a
+    # warm L1/L2 match without re-prefill
+    "engine.prefix_demote",
+    "engine.prefix_promote",
     # serve layer
     "serve.run_started",
     "serve.run",

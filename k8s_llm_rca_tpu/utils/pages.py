@@ -1,0 +1,192 @@
+"""Host page-record helpers: the ONE definition of the page-granular
+d2h gather / h2d restore and the suffix bucket math that KV spill
+(engine/paged.py ``_maybe_spill``/``_admit_spilled``, PR 8) and the
+tiered prefix cache (engine/prefix.py ``PrefixStore``) share.
+
+A *page record* is the host-side image of pool pages: ``{"n_pages": n,
+"k": [L, n, page, kv], "v": ..., ["k_scale": [L, n, page],
+"v_scale": ...]}`` — numpy arrays gathered with ONE coalesced fetch
+(``EngineBase._fetch``), exactly the spill record layout.  Keeping the
+gather, the restore scatter and the bucket arithmetic here means the
+spill path and the prefix tiers cannot drift: both are byte-identical
+users of the same three functions.
+
+The disk codec frames one per-page record with the WAL recipe
+(utils/wal.py): a JSON field header, a NUL separator, then the raw
+array bytes, all inside one CRC32 frame.  ``decode_page_record``
+returns None on ANY defect (torn frame, bad CRC, malformed header,
+short payload) — a corrupt on-disk page is a silent cold miss for the
+tiered cache, never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from k8s_llm_rca_tpu.utils import wal
+
+# record keys holding page arrays, in gather/restore/serialization order
+_KV_FIELDS = ("k", "v")
+_SCALE_FIELDS = ("k_scale", "v_scale")
+
+
+def suffix_bucket(bucket_of: Callable[[int], int], rest_len: int,
+                  n_shared: int, page_size: int,
+                  pages_per_seq: int) -> Tuple[int, int]:
+    """Bucket a sequence SUFFIX that begins after ``n_shared`` already-
+    held pages (prefix-cache hit, spill restore): the padded bucket is
+    capped at the table space left past the shared run (always >=
+    rest_len: n_shared*page + rest_len <= pages_per_seq*page).  Returns
+    ``(bucket_tokens, n_pages)``.  One definition — ``_admit``,
+    ``_admit_chunked``, ``_admit_spilled`` and prefix-tier promotion
+    must all evolve allocator state through identical arithmetic for
+    the byte-parity matrix to hold."""
+    bucket = min(bucket_of(rest_len), (pages_per_seq - n_shared) * page_size)
+    return bucket, bucket // page_size
+
+
+def gather_pages(pool, fetch: Callable, page_ids: Sequence[int]
+                 ) -> Dict[str, object]:
+    """ONE coalesced d2h gather of ``page_ids`` from the pool's page
+    axis (axis 1).  ``fetch`` is ``EngineBase._fetch`` — every array
+    starts its async copy before any materializes, so the group costs
+    one sync point.  Returns a page record (host numpy arrays)."""
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(np.asarray(list(page_ids), np.int32))
+    gathered = [jnp.take(pool.k, idx, axis=1),
+                jnp.take(pool.v, idx, axis=1)]
+    if pool.quantized:
+        gathered += [jnp.take(pool.k_scale, idx, axis=1),
+                     jnp.take(pool.v_scale, idx, axis=1)]
+    host = fetch(*gathered)
+    rec: Dict[str, object] = {"n_pages": len(page_ids),
+                              "k": host[0], "v": host[1]}
+    if pool.quantized:
+        rec["k_scale"], rec["v_scale"] = host[2], host[3]
+    return rec
+
+
+def restore_pages(pool, rec: Dict[str, object], page_ids: Sequence[int]):
+    """h2d-scatter a page record back into fresh pool pages (the exact
+    inverse of ``gather_pages``); returns the updated pool."""
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(np.asarray(list(page_ids), np.int32))
+    k = pool.k.at[:, idx].set(jnp.asarray(rec["k"]))
+    v = pool.v.at[:, idx].set(jnp.asarray(rec["v"]))
+    if pool.quantized:
+        return pool._replace(
+            k=k, v=v,
+            k_scale=pool.k_scale.at[:, idx].set(
+                jnp.asarray(rec["k_scale"])),
+            v_scale=pool.v_scale.at[:, idx].set(
+                jnp.asarray(rec["v_scale"])))
+    return pool._replace(k=k, v=v)
+
+
+def record_fields(rec: Dict[str, object]) -> Tuple[str, ...]:
+    """Array field names present in a page record, canonical order."""
+    return _KV_FIELDS + (_SCALE_FIELDS
+                         if "k_scale" in rec else ())
+
+
+def record_nbytes(rec: Dict[str, object]) -> int:
+    """Total payload bytes a record holds (obs accounting)."""
+    return sum(np.asarray(rec[f]).nbytes for f in record_fields(rec))
+
+
+def split_pages(rec: Dict[str, object]) -> List[Dict[str, object]]:
+    """Split a multi-page record into per-page records (page axis kept,
+    length 1).  Arrays are contiguous COPIES: a store entry must own
+    its bytes so evicting it actually frees host memory instead of
+    pinning the whole gathered block alive."""
+    out: List[Dict[str, object]] = []
+    fields = record_fields(rec)
+    for i in range(int(rec["n_pages"])):
+        page: Dict[str, object] = {"n_pages": 1}
+        for f in fields:
+            page[f] = np.ascontiguousarray(
+                np.asarray(rec[f])[:, i:i + 1])
+        out.append(page)
+    return out
+
+
+def stack_pages(recs: Sequence[Dict[str, object]]) -> Dict[str, object]:
+    """Concatenate per-page records along the page axis — the single
+    record ``restore_pages`` scatters in one h2d write."""
+    fields = record_fields(recs[0])
+    rec: Dict[str, object] = {
+        "n_pages": sum(int(r["n_pages"]) for r in recs)}
+    for f in fields:
+        rec[f] = np.concatenate([np.asarray(r[f]) for r in recs], axis=1)
+    return rec
+
+
+def records_compatible(pool, rec: Dict[str, object]) -> bool:
+    """Whether a (per-page) record's dtypes/shapes match THIS pool —
+    a store shared across engine configs must reject mismatched pages
+    as cold misses, not scatter garbage."""
+    fields = (_KV_FIELDS + _SCALE_FIELDS if pool.quantized
+              else _KV_FIELDS)
+    if record_fields(rec) != fields:
+        return False
+    for f in fields:
+        arr = np.asarray(rec[f])
+        ref = getattr(pool, f)
+        want = (ref.shape[0], 1) + tuple(ref.shape[2:])
+        if arr.shape != want or arr.dtype != ref.dtype:
+            return False
+    return True
+
+
+# --------------------------------------------------------------- disk codec
+
+def encode_page_record(rec: Dict[str, object]) -> bytes:
+    """One CRC-framed disk entry for a per-page record: JSON header
+    (field name/dtype/shape triples) + NUL + concatenated raw bytes,
+    wrapped in ``wal.pack_record``.  Raises ValueError past
+    ``wal.MAX_RECORD_SIZE`` (callers skip persistence, never crash)."""
+    fields = record_fields(rec)
+    header = {"n_pages": int(rec["n_pages"]),
+              "fields": [[f, np.asarray(rec[f]).dtype.str,
+                          list(np.asarray(rec[f]).shape)]
+                         for f in fields]}
+    blob = b"".join(np.ascontiguousarray(np.asarray(rec[f])).tobytes()
+                    for f in fields)
+    return wal.pack_record(
+        json.dumps(header, sort_keys=True).encode() + b"\0" + blob)
+
+
+def decode_page_record(data: bytes) -> Optional[Dict[str, object]]:
+    """Inverse of ``encode_page_record``; None on ANY defect (torn or
+    corrupt frame, bad header, truncated payload) — the tiered cache
+    treats that as a cold miss."""
+    try:
+        payload = None
+        for payload, _ in wal.iter_records(data):
+            break
+        if payload is None:
+            return None
+        head, sep, blob = payload.partition(b"\0")
+        if not sep:
+            return None
+        header = json.loads(head.decode())
+        rec: Dict[str, object] = {"n_pages": int(header["n_pages"])}
+        off = 0
+        for name, dtype_str, shape in header["fields"]:
+            dt = np.dtype(dtype_str)
+            n = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+            chunk = blob[off:off + n]
+            if len(chunk) != n:
+                return None
+            rec[name] = np.frombuffer(chunk, dtype=dt).reshape(shape)
+            off += n
+        if off != len(blob):
+            return None
+        return rec
+    except Exception:
+        return None

@@ -231,7 +231,9 @@ class _StubEngine:
     def __init__(self):
         self._active = {0: object(), 1: object()}
         self._pending = [object()]
-        self._counts = {"engine.prefix_hit_tokens": 7.0}
+        self._counts = {"engine.prefix_hit_tokens": 7.0,
+                        "engine.prefix_hits_l1": 5.0,
+                        "engine.prefix_demotions": 9.0}
         self.allocator = type("A", (), {"n_free": 11})()
         self.prefix_cache = type("P", (), {"n_evictable": 3})()
 
@@ -274,6 +276,9 @@ class TestPrometheus:
         assert "k8s_llm_rca_engine_free_pages 11" in text
         assert "k8s_llm_rca_engine_evictable_pages 3" in text
         assert "k8s_llm_rca_engine_prefix_hit_tokens 7" in text
+        assert "k8s_llm_rca_engine_prefix_hits_l1 5" in text
+        assert "k8s_llm_rca_engine_prefix_hits_l0 0" in text
+        assert "k8s_llm_rca_engine_prefix_demotions 9" in text
         assert "# TYPE k8s_llm_rca_engine_free_pages gauge" in text
 
     def test_serve_api_surfaces_rendering(self, small_engine):
@@ -378,8 +383,8 @@ class TestTracedSoak:
         validate_chrome_trace(doc)
         counter_names = {e["name"] for e in doc["traceEvents"]
                          if e["ph"] == "C"}
-        assert {"engine.seqs", "engine.pages",
-                "engine.tokens", "engine.sched"} <= counter_names
+        assert {"engine.seqs", "engine.pages", "engine.tokens",
+                "engine.sched", "engine.prefix"} <= counter_names
 
     def test_cluster_counter_tracks_separate_by_replica(self):
         """TickSamples stamped with engine_id render onto per-replica
@@ -544,6 +549,34 @@ class TestSiteCoverage:
             assert "quarantined" in heal_res[h_heal].error
         assert {"cluster.health", "cluster.restart", "cluster.quarantine",
                 "cluster.mttd", "cluster.mttr"} <= tr_heal.emitted_names()
+
+        # (7) tiered prefix-cache sites: run a prefix-hitting prompt,
+        # demote every resident page into the host store (engine
+        # .prefix_demote, d2h), then re-run so tier-aware match promotes
+        # them back (engine.prefix_promote, h2d)
+        tr_tier = Tracer(clock=VirtualClock())
+        tracers.append(tr_tier)
+        tier_eng = make_engine(
+            TINY.replace(max_seq_len=64),
+            EngineConfig(max_batch=2, max_seq_len=64, paged=True,
+                         page_size=8, num_pages=24,
+                         prefill_buckets=(16, 32), max_new_tokens=4,
+                         temperature=0.0, prefix_cache=True,
+                         prefix_host_pages=24),
+            engine.params, tok, use_kernel=False)
+        with obs_trace.tracing(tr_tier):
+            tier_eng.submit(tok.encode("node notready on node-3"))
+            while tier_eng.has_work:
+                tier_eng.step()
+            assert tier_eng.prefix_cache.evict(10 ** 6) > 0
+            tier_eng.submit(tok.encode("node notready on node-3"))
+            while tier_eng.has_work:
+                tier_eng.step()
+        assert {"engine.prefix_demote", "engine.prefix_promote"} \
+            <= tr_tier.emitted_names()
+        tier_c = tier_eng._counts or {}
+        assert tier_c.get("engine.prefix_demotions", 0) > 0
+        assert tier_c.get("engine.prefix_hits_l1", 0) > 0
 
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
