@@ -276,7 +276,13 @@ def bench_rca_p50_engine(n_incidents: int = 100, workers: int = 16,
     the best p50 (the second BASELINE metric) at ~84% of the peak sweep
     throughput; the ladder is the documented answer to pushing tok/s
     higher.  Returns [p50, n, workers, tps, mfu, tokens, wall,
-    occupancy, ticks, max_batch]."""
+    occupancy, ticks, max_batch].
+
+    The PUBLISHED sweep leg is bench_rca_sweep_pipelined since the
+    pipelined scheduler landed — identical workload and counters, the
+    blocking wait_run loops replaced by one shared pump — so this
+    threaded variant remains as the refthreads leg's driver and the
+    slots x workers ladder's instrument."""
     import queue
     import threading
 
@@ -384,6 +390,137 @@ def bench_rca_p50_engine(n_incidents: int = 100, workers: int = 16,
             round(wall, 2),
             round(occ, 4) if occ is not None else None, int(ticks),
             max_batch]
+
+
+def bench_rca_sweep_pipelined(n_incidents: int = 100, concurrency: int = 16,
+                              decode_chunk: int = 32, max_batch: int = 16,
+                              max_seq_len: int = 4096,
+                              spec_probe_incidents: int = 8,
+                              speculative_k: int = 4):
+    """The DEFAULT RCA sweep leg: the same 100-incident workload as
+    bench_rca_p50_engine, driven by the PIPELINED sweep scheduler
+    (rca/scheduler.py) instead of blocking worker threads — K incidents
+    in flight on ONE engine, each submitting its next LLM run and
+    yielding, one shared pump loop firing a tick only when every
+    in-flight incident is parked on a pending run.  BENCH_r05 pinned the
+    sweep gap as scheduling (occupancy 0.41 vs the flagship legs' 0.99:
+    every stage blocked in serve/api.py::wait_run, each thread pumping
+    for only its own run); the scheduler admits a new incident the tick
+    one retires and never pumps a tick that no incident is waiting on,
+    so ticks are fewer and fuller.  Methodology is unchanged — committed
+    decode tokens over host wall-clock across hundreds of real,
+    data-dependent ticks, memoization-immune — so the occupancy/tok-s
+    numbers are comparable round over round.  Per-incident ``time_cost``
+    spans admission-to-result while K-1 other incidents share the engine:
+    that IS serving latency under continuous batching.
+
+    The speculative PROBE: a second, smaller sweep on a fresh engine with
+    n-gram speculation enabled (``speculative_k``; greedy-exact by
+    construction — engine/_verify_and_commit commits only the draft
+    prefix the model itself would have chosen, tests/test_speculative.py
+    and tests/test_sweep_sched.py hold byte-parity) measures
+    ``spec_accept_rate`` = accepted/drafted draft tokens from the
+    engine's exact counters.  It runs SEPARATELY because a speculative
+    tick carries at most k+1 tokens/slot vs the ``decode_chunk``-step
+    scan's 32 on this dispatch-bound host (~0.25 s/tick regardless of
+    content): enabling it on the headline run would measure the dispatch
+    floor, not the scheduler.  Returns a self-describing dict."""
+    import jax as _jax
+
+    from k8s_llm_rca_tpu.engine import make_engine
+    from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+    from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS, build_metagraph, \
+        build_stategraph
+    from k8s_llm_rca_tpu.rca import RCAPipeline
+    from k8s_llm_rca_tpu.rca.scheduler import IncidentFailure, SweepScheduler
+    from k8s_llm_rca_tpu.runtime import profiling
+    from k8s_llm_rca_tpu.serve.api import AssistantService
+    from k8s_llm_rca_tpu.serve.backend import EngineBackend
+    from k8s_llm_rca_tpu.utils.logging import METRICS
+
+    cfg = TINY.replace(max_seq_len=max_seq_len)
+    params = llama.init_params(cfg, _jax.random.PRNGKey(0))
+    tok = get_tokenizer(vocab_size=cfg.vocab_size)
+    buckets = tuple(b for b in (1024, 2048, 4096, 8192, 16384)
+                    if b <= max_seq_len)
+
+    def build_sched(spec_k: int, k: int):
+        engine = make_engine(
+            cfg, EngineConfig(max_batch=max_batch, max_seq_len=max_seq_len,
+                              prefill_buckets=buckets,
+                              max_new_tokens=64, temperature=0.0,
+                              decode_chunk=decode_chunk,
+                              host_overlap=True,
+                              speculative_k=spec_k),
+            params, tok)
+        service = AssistantService(EngineBackend(engine))
+        pipelines = [
+            RCAPipeline(service,
+                        InMemoryGraphExecutor(build_metagraph()),
+                        InMemoryGraphExecutor(build_stategraph()),
+                        RCAConfig(cypher_max_new_tokens=64,
+                                  analyzer_max_new_tokens=64,
+                                  fresh_threads=True))
+            for _ in range(k)]
+        return SweepScheduler(pipelines)
+
+    messages = [INCIDENTS[i % len(INCIDENTS)].message
+                for i in range(n_incidents)]
+
+    sched = build_sched(0, concurrency)
+    tokens0 = METRICS.count("engine.decode_tokens")
+    ticks0 = _metrics_ticks()
+    t0 = time.perf_counter()
+    results = sched.run(messages)
+    wall = time.perf_counter() - t0
+    tokens = METRICS.count("engine.decode_tokens") - tokens0
+    ticks = _metrics_ticks() - ticks0
+    failures = sum(1 for r in results if isinstance(r, IncidentFailure))
+    for r in results:
+        if isinstance(r, IncidentFailure):
+            print(f"[bench] incident failed: {r.error}", file=sys.stderr)
+    costs = sorted(r["time_cost"] for r in results
+                   if not isinstance(r, IncidentFailure))
+    tps = tokens / wall if wall > 0 else None
+    # same ASSUMED mean context as the threaded leg's sanity cross-check
+    m = profiling.mfu(cfg, tps, 1024) if tps is not None else None
+    occ = (tokens / (ticks * max_batch * decode_chunk)
+           if ticks else None)
+
+    # --- speculative probe (fresh engine, same workload prefix)
+    spec_rate = drafted = accepted = None
+    if spec_probe_incidents > 0 and speculative_k > 0:
+        spec_sched = build_sched(speculative_k,
+                                 min(concurrency, spec_probe_incidents))
+        d0 = METRICS.count("engine.spec_drafted")
+        a0 = METRICS.count("engine.spec_accepted")
+        spec_results = spec_sched.run(messages[:spec_probe_incidents])
+        for r in spec_results:
+            if isinstance(r, IncidentFailure):
+                print(f"[bench] spec probe incident failed: {r.error}",
+                      file=sys.stderr)
+        drafted = METRICS.count("engine.spec_drafted") - d0
+        accepted = METRICS.count("engine.spec_accepted") - a0
+        spec_rate = accepted / drafted if drafted else None
+
+    stats = sched.stats
+    return {"p50": costs[len(costs) // 2] if costs else None,
+            "p99": costs[min(len(costs) - 1, int(len(costs) * 0.99))]
+            if costs else None,
+            "n": len(costs), "failures": failures,
+            "concurrency": concurrency,
+            "inflight_mean": round(stats.inflight_mean(), 4),
+            "pumps": stats.pumps,
+            "tps": round(tps, 2) if tps is not None else None,
+            "mfu": round(m, 6) if m is not None else None,
+            "tokens": int(tokens), "wall_s": round(wall, 2),
+            "occupancy": round(occ, 4) if occ is not None else None,
+            "ticks": int(ticks), "batch": max_batch,
+            "spec_accept_rate": round(spec_rate, 4)
+            if spec_rate is not None else None,
+            "spec_drafted": int(drafted) if drafted is not None else None,
+            "spec_accepted": int(accepted)
+            if accepted is not None else None}
 
 
 def bench_rca_chaos(seed: int = 0, n_incidents: int = 6):
@@ -1070,10 +1207,23 @@ def main():
         eng_8b = _leg("bench.bench_8b_leg()", timeout=1800)
         kern = _leg("bench.bench_kernel_leg()", timeout=3600)
     p50_oracle = _leg("bench.bench_rca_p50()")
-    sweep = _leg("bench.bench_rca_p50_engine()", timeout=1800)
-    (p50_engine, n_engine, n_workers, eng_tps, eng_mfu, eng_tokens,
-     eng_wall, eng_occ, eng_ticks, eng_batch) = \
-        sweep if sweep else (None,) * 10
+    # the DEFAULT sweep leg is the pipelined scheduler (ISSUE 11): same
+    # workload and methodology as the retired threaded leg
+    # (bench_rca_p50_engine stays callable — the refthreads leg and the
+    # documented slots x workers ladder still use it), so occupancy/p50
+    # stay comparable against BENCH_r05's 0.41 / 14.4 s
+    sweep = _leg("bench.bench_rca_sweep_pipelined()", timeout=1800) or {}
+    p50_engine = sweep.get("p50")
+    p99_engine = sweep.get("p99")
+    n_engine = sweep.get("n")
+    eng_conc = sweep.get("concurrency")
+    eng_tps = sweep.get("tps")
+    eng_mfu = sweep.get("mfu")
+    eng_tokens = sweep.get("tokens")
+    eng_wall = sweep.get("wall_s")
+    eng_occ = sweep.get("occupancy")
+    eng_ticks = sweep.get("ticks")
+    eng_batch = sweep.get("batch")
     ref_sweep = _leg("bench.bench_rca_p50_engine_refthreads()",
                      timeout=1800)
     p50_refthreads = ref_sweep[0] if ref_sweep else None
@@ -1184,11 +1334,22 @@ def main():
         if p50_oracle is not None else None,
         "rca_p50_engine_s": round(p50_engine, 4)
         if p50_engine is not None else None,
+        "rca_p99_engine_s": round(p99_engine, 4)
+        if p99_engine is not None else None,
         # reference-faithful growing-thread semantics (r4 weak #4)
         "rca_p50_engine_refthreads_s": round(p50_refthreads, 4)
         if p50_refthreads is not None else None,
         "rca_engine_incidents": n_engine,
-        "rca_engine_workers": n_workers,
+        # K incidents in flight on the pipelined scheduler (the sweep
+        # leg's parallelism degree; was worker threads through r05)
+        "rca_engine_workers": eng_conc,
+        "sweep_inflight_incidents_mean": sweep.get("inflight_mean"),
+        # accepted/drafted n-gram draft tokens from the engine's exact
+        # counters, measured by the leg's speculative probe sweep (its
+        # docstring documents why the probe runs separately from the
+        # headline occupancy run on this dispatch-bound host)
+        "sweep_spec_accept_rate": sweep.get("spec_accept_rate"),
+        "sweep_spec_drafted": sweep.get("spec_drafted"),
         # overlapped hot loop (docs/performance.md): counter-ratio
         # comparison (exact, memoization-immune) plus measured tok/s of
         # the overlap run; null when the leg failed — schema stays stable

@@ -1154,6 +1154,7 @@ class EngineBase:
                                         0.0),
             prefix_bytes_restored=c.get("engine.prefix_bytes_restored",
                                         0.0),
+            idle_ticks=c.get("engine.idle_ticks", 0.0),
             queued_critical=g.get("queued_critical", 0),
             queued_normal=g.get("queued_normal", 0),
             queued_batch=g.get("queued_batch", 0),

@@ -88,6 +88,14 @@ class FaultPlan:
         self.fired: List[Fault] = []
         self._cleanups: List[Callable[[], None]] = []
 
+    @property
+    def has_faults(self) -> bool:
+        """True when any fault is actually scheduled.  An empty plan is
+        interleaving-safe (poll counters are per-site sums, and nothing
+        fires), so the pipelined sweep scheduler only excludes armed
+        plans for which this is True."""
+        return bool(self._by_site)
+
     # ------------------------------------------------------------- build
 
     @classmethod

@@ -54,7 +54,8 @@ def default_plan_spec() -> Dict[str, Dict[str, Any]]:
     }
 
 
-def _build_engine_service(run_timeout_s: float, clock, journal=None):
+def _build_engine_service(run_timeout_s: float, clock, journal=None,
+                          engine_overrides=None):
     import jax
 
     from k8s_llm_rca_tpu.config import TINY, EngineConfig
@@ -66,17 +67,28 @@ def _build_engine_service(run_timeout_s: float, clock, journal=None):
 
     # sized for the tier-1 budget: ONE prefill bucket (one compile shape),
     # no prefix cache (prefix-hit admission has its own compile shapes and
-    # its own tests), a cache just big enough for the stage prompts
+    # its own tests), a cache just big enough for the stage prompts.
+    # ``engine_overrides``: EngineConfig field overrides for the pipelined
+    # sweep's composition matrix (prefix_cache, host_overlap, chunked
+    # prefill, speculative decode ... — tests/test_sweep_sched.py).
     cfg = TINY.replace(max_seq_len=2560)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     tok = get_tokenizer(vocab_size=cfg.vocab_size)
-    engine = make_engine(
-        cfg, EngineConfig(max_batch=4, max_seq_len=2560,
-                          prefill_buckets=(2560,),
-                          max_new_tokens=96, temperature=0.0,
-                          paged=True, page_size=64, num_pages=168,
-                          prefix_cache=False, decode_chunk=16),
-        params, tok, use_kernel=False)
+    ecfg = EngineConfig(max_batch=4, max_seq_len=2560,
+                        prefill_buckets=(2560,),
+                        max_new_tokens=96, temperature=0.0,
+                        paged=True, page_size=64, num_pages=168,
+                        prefix_cache=False, decode_chunk=16)
+    if engine_overrides:
+        import dataclasses as _dc
+
+        ecfg = _dc.replace(ecfg, **engine_overrides)
+    engine = make_engine(cfg, ecfg, params, tok, use_kernel=False)
+    # deadlines on the soak's virtual clock, ARMED OR NOT: without this
+    # the engine falls back to the armed plan's clock (same object) or —
+    # in plan-free pipelined sweeps — to WALL time, where the first
+    # compile alone blows the 1.5 s run deadline
+    engine.clock = clock
     # the factory hands the SAME engine to a restarted backend: it stands
     # in for the restarted worker's recompiled engine (identical weights,
     # identical compile) without paying a per-crash recompile
@@ -139,6 +151,10 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
                          prefix_cache=False, decode_chunk=16),
             n_replicas, seed=0, use_kernel=False)
         engines = [r.backend.engine for r in replicas]
+        for eng in engines:
+            # virtual-clock deadlines even without an armed plan (see
+            # _build_engine_service)
+            eng.clock = clock
     router = ClusterRouter(replicas)
     if selfheal:
         from k8s_llm_rca_tpu.cluster import (
@@ -153,6 +169,25 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
             engines, factory, router)
 
 
+def _incident_row(message: str, result: Dict[str, Any]) -> Dict[str, Any]:
+    """Deterministic report row for one completed incident — the fields
+    every sweep report carries (wall-clock cost and windowed token usage
+    intentionally excluded, see module docstring)."""
+    row: Dict[str, Any] = {"error_message": message}
+    degraded = result.get("degraded", [])
+    row["status"] = "degraded" if degraded else "resolved"
+    row["degraded"] = degraded
+    row["locator_attempts"] = result.get("locator_attempts")
+    if "flight" in result:    # traced soak: deterministic digest
+        row["flight"] = result["flight"]
+    row["analyses"] = [
+        {"cypher_attempts": a.get("cypher_attempts"),
+         "used_fallback": "human_cypher_query" in a,
+         "n_statepaths": len(a.get("statepath", []))}
+        for a in result.get("analysis", [])]
+    return row
+
+
 def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    backend: str = "engine",
                    plan_spec: Optional[Dict[str, Any]] = None,
@@ -162,7 +197,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    supervisor: Optional[Any] = None,
                    cluster_replicas: int = 2,
                    killer: Optional[Any] = None,
-                   selfheal: bool = False) -> Dict[str, Any]:
+                   selfheal: bool = False,
+                   concurrency: int = 1) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
 
@@ -213,6 +249,18 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     After the sweep the router is pumped a few extra (plan-free) times
     so a wedge landed at the last boundary still heals before the
     engine-clean check.
+
+    ``concurrency``: incidents in flight at once (rca/scheduler.py).  At
+    1 (the default) the historical sequential loop runs unchanged.
+    Above 1 the sweep is driven by the pipelined SweepScheduler — K slot
+    pipelines over the one service — which is only legal without chaos
+    machinery: a plan with scheduled faults (fault-to-incident
+    attribution is interleaving-dependent), a supervisor/killer
+    (boundary polls need a global incident order), or selfheal all raise
+    loud ValueErrors.  An EMPTY plan stays armed, so the report's
+    ``faults.polls`` counters (per-site sums, interleaving-invariant)
+    match the sequential run's and report bytes stay comparable across
+    concurrencies.
     """
     from k8s_llm_rca_tpu.config import RCAConfig
     from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
@@ -224,6 +272,24 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     clock = VirtualClock()
     plan = FaultPlan.from_spec(seed, plan_spec or default_plan_spec(),
                                clock=clock)
+    if concurrency < 1:
+        raise ValueError(f"concurrency must be >= 1, got {concurrency}")
+    if concurrency > 1:
+        if plan.has_faults:
+            raise ValueError(
+                "chaos soak with concurrency > 1 is not supported: "
+                "scheduled faults are attributed to incidents by poll "
+                "order, which is interleaving-dependent — the report "
+                "could never match the sequential run.  Run chaos at "
+                "concurrency=1, or pass an empty plan_spec (plan-free "
+                "pipelined sweeps: run_pipelined_sweep)")
+        if supervisor is not None or killer is not None or selfheal:
+            raise ValueError(
+                "crash/kill/selfheal machinery polls once per incident "
+                "BOUNDARY — a pipelined sweep has no global incident "
+                "order, so the schedules could never match; concurrency "
+                "> 1 requires supervisor=None, killer=None, "
+                "selfheal=False")
     policy = ResiliencePolicy(
         retry=RetryPolicy(max_attempts=3, base_delay_s=0.01,
                           max_delay_s=0.1, deadline_s=5.0, seed=seed,
@@ -279,6 +345,27 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
         RCAConfig(locator_max_new_tokens=192, cypher_max_new_tokens=96,
                   analyzer_max_new_tokens=96, fresh_threads=True),
         resilience=policy)
+    pipelines: List[RCAPipeline] = [pipeline]
+    if concurrency > 1:
+        # K slot pipelines over the ONE service; slot 0 is the
+        # already-seeded pipeline.  Built HERE, before arming, for the
+        # same reason as slot 0: __post_init__'s vocabulary bootstrap
+        # issues a graph.query, and counting K-1 extra setup polls in
+        # ``faults.polls`` would make the report depend on concurrency.
+        # Clones share cfg and executors but get their OWN ladder
+        # policy (same constants, same shared retry object):
+        # ResiliencePolicy.degradations is per-incident state reset by
+        # begin_incident, so one shared instance across interleaved
+        # machines would let machine A's reset wipe machine B's
+        # accumulating annotations
+        pipelines += [
+            RCAPipeline(service, meta, state, pipeline.cfg,
+                        resilience=ResiliencePolicy(
+                            retry=policy.retry,
+                            failure_threshold=policy.failure_threshold,
+                            reset_timeout_s=policy.reset_timeout_s,
+                            reduced_tokens=policy.reduced_tokens))
+            for _ in range(concurrency - 1)]
 
     obs_ctx: Any = contextlib.nullcontext()
     if tracer is not None:
@@ -290,54 +377,68 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     incidents: List[Dict[str, Any]] = []
     n_resolved = n_degraded = n_failed = 0
     with inject.armed(plan), obs_ctx:
-        for i in range(n_incidents):
-            message = INCIDENTS[i % len(INCIDENTS)].message
-            row: Dict[str, Any] = {"error_message": message}
-            try:
-                result = pipeline.analyze_incident(message)
-            except Exception as e:      # noqa: BLE001 — must never happen:
-                # the ladder's bottom rungs are infallible; a row here is
-                # a soak FAILURE the test asserts against
-                row["status"] = "failed"
-                row["error"] = f"{type(e).__name__}: {e}"
-                n_failed += 1
+        if concurrency > 1:
+            from k8s_llm_rca_tpu.rca.scheduler import (
+                IncidentFailure, SweepScheduler,
+            )
+
+            messages = [INCIDENTS[i % len(INCIDENTS)].message
+                        for i in range(n_incidents)]
+            for message, result in zip(
+                    messages, SweepScheduler(pipelines).run(messages)):
+                if isinstance(result, IncidentFailure):
+                    incidents.append({"error_message": message,
+                                      "status": "failed",
+                                      "error": result.error})
+                    n_failed += 1
+                    continue
+                row = _incident_row(message, result)
+                if row["status"] == "degraded":
+                    n_degraded += 1
+                else:
+                    n_resolved += 1
+                incidents.append(row)
+        else:
+            for i in range(n_incidents):
+                message = INCIDENTS[i % len(INCIDENTS)].message
+                try:
+                    result = pipeline.analyze_incident(message)
+                except Exception as e:  # noqa: BLE001 — must never happen:
+                    # the ladder's bottom rungs are infallible; a row here
+                    # is a soak FAILURE the test asserts against
+                    incidents.append({"error_message": message,
+                                      "status": "failed",
+                                      "error": f"{type(e).__name__}: {e}"})
+                    n_failed += 1
+                    if supervisor is not None:
+                        # keep supervisor polls at exactly one per incident
+                        # (both outcome paths), so its schedule is a pure
+                        # function of (plan, n_incidents)
+                        service = supervisor.checkpoint(
+                            pipeline, service, factory, run_timeout_s,
+                            clock)
+                    if killer is not None:
+                        killer.checkpoint()
+                    continue
+                row = _incident_row(message, result)
+                if row["status"] == "degraded":
+                    n_degraded += 1
+                else:
+                    n_resolved += 1
                 incidents.append(row)
                 if supervisor is not None:
-                    # keep supervisor polls at exactly one per incident
-                    # (both outcome paths), so its schedule is a pure
-                    # function of (plan, n_incidents)
+                    # incident boundary: the supervisor's own plan decides
+                    # whether the "process" dies here; on crash the
+                    # recovered service replaces ours (pipeline rebound
+                    # inside)
                     service = supervisor.checkpoint(
                         pipeline, service, factory, run_timeout_s, clock)
                 if killer is not None:
+                    # same discipline, replica granularity: exactly one
+                    # poll per incident on both outcome paths (the
+                    # killer's own plan; the router fails the victim over
+                    # in place)
                     killer.checkpoint()
-                continue
-            degraded = result.get("degraded", [])
-            row["status"] = "degraded" if degraded else "resolved"
-            row["degraded"] = degraded
-            row["locator_attempts"] = result.get("locator_attempts")
-            if "flight" in result:    # traced soak: deterministic digest
-                row["flight"] = result["flight"]
-            row["analyses"] = [
-                {"cypher_attempts": a.get("cypher_attempts"),
-                 "used_fallback": "human_cypher_query" in a,
-                 "n_statepaths": len(a.get("statepath", []))}
-                for a in result.get("analysis", [])]
-            if degraded:
-                n_degraded += 1
-            else:
-                n_resolved += 1
-            incidents.append(row)
-            if supervisor is not None:
-                # incident boundary: the supervisor's own plan decides
-                # whether the "process" dies here; on crash the recovered
-                # service replaces ours (pipeline rebound inside)
-                service = supervisor.checkpoint(
-                    pipeline, service, factory, run_timeout_s, clock)
-            if killer is not None:
-                # same discipline, replica granularity: exactly one poll
-                # per incident on both outcome paths (the killer's own
-                # plan; the router fails the victim over in place)
-                killer.checkpoint()
 
         if router is not None and router.health is not None:
             # kill-and-heal drain: a wedge landed at the LAST incident
@@ -406,6 +507,223 @@ def report_bytes(report: Dict[str, Any]) -> bytes:
     """Canonical bytes of a soak report (the byte-identity check)."""
     return json.dumps(report, sort_keys=True,
                       separators=(",", ":")).encode()
+
+
+def run_pipelined_sweep(seed: int = 0, n_incidents: int = 10,
+                        backend: str = "engine", concurrency: int = 4,
+                        run_timeout_s: float = 1.5,
+                        incidents: Optional[List[str]] = None,
+                        tracer: Optional[Any] = None,
+                        durable_dir: Optional[str] = None,
+                        resilience: bool = False,
+                        cluster_replicas: int = 2,
+                        engine_overrides: Optional[Dict[str, Any]] = None,
+                        rca_overrides: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """Plan-free pipelined RCA sweep: ``concurrency`` incidents in flight
+    over one shared backend (rca/scheduler.py::SweepScheduler).
+
+    This is the scheduling-parity and bench surface of ISSUE 11: the
+    returned ``report`` carries only scheduling-INVARIANT fields — per-
+    incident statuses, degradation annotations, attempt counts, the
+    decoded cypher queries and audit report texts, and exact run-id-
+    attributed token usage — so ``report_bytes(out["report"])`` must be
+    byte-identical across concurrencies (1 vs 4 vs 16) under greedy
+    decode.  Everything scheduling-DEPENDENT (pump counts, inflight
+    samples, queue-wait spans, flight summaries, resilience counters)
+    lives in ``out["stats"]`` instead.
+
+    ``backend``: "engine" | "oracle" | "cluster" | "cluster-oracle" (the
+    chaos soak's stacks, built plan-free).  ``incidents``: explicit
+    message list (tests interleave retry-with-feedback and resilience-
+    ladder incidents); default is the canned corpus cycled
+    ``n_incidents`` times.  ``resilience``: arm the degradation ladder
+    (identical policy constants to the chaos soak).
+    ``engine_overrides`` / ``rca_overrides``: EngineConfig / RCAConfig
+    field overrides for the composition matrix (prefix cache, host
+    overlap, chunked prefill, speculative decode, concurrent audits).
+
+    Returns ``{"report", "stats", "service", "engines", "router"}`` —
+    the live handles let tests run the journal/recovery agreement and
+    engine-clean checks against the exact stack the sweep used.
+    """
+    from k8s_llm_rca_tpu.config import RCAConfig
+    from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+    from k8s_llm_rca_tpu.graph.fixtures import (
+        INCIDENTS, build_metagraph, build_stategraph,
+    )
+    from k8s_llm_rca_tpu.rca import RCAPipeline
+    from k8s_llm_rca_tpu.rca.scheduler import (
+        IncidentFailure, SweepScheduler,
+    )
+
+    if concurrency < 1:
+        raise ValueError(f"concurrency must be >= 1, got {concurrency}")
+
+    clock = VirtualClock()
+    journal = None
+    if durable_dir is not None:
+        import os
+
+        from k8s_llm_rca_tpu.serve.journal import RunJournal
+
+        os.makedirs(durable_dir, exist_ok=True)
+        journal = RunJournal(os.path.join(durable_dir, "serve.wal"))
+
+    router = None
+    if backend == "engine":
+        service, engine, _factory = _build_engine_service(
+            run_timeout_s, clock, journal,
+            engine_overrides=engine_overrides)
+        engines = [engine]
+    elif backend in ("cluster", "cluster-oracle"):
+        if engine_overrides:
+            raise ValueError("engine_overrides applies to the single-"
+                             "engine backend only (cluster replicas pin "
+                             "the soak's TINY config)")
+        service, engines, _factory, router = _build_cluster_service(
+            run_timeout_s, clock, journal, n_replicas=cluster_replicas,
+            oracle=(backend == "cluster-oracle"))
+    elif backend == "oracle":
+        if engine_overrides:
+            raise ValueError("engine_overrides applies to the single-"
+                             "engine backend only")
+        service, _engine, _factory = _build_oracle_service(
+            run_timeout_s, clock, journal)
+        engines = []
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+
+    policy = None
+    slot_policies: List[Optional[ResiliencePolicy]] = [None] * concurrency
+    meta: Any = InMemoryGraphExecutor(build_metagraph())
+    state: Any = InMemoryGraphExecutor(build_stategraph())
+    if resilience:
+        policy = ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                              max_delay_s=0.1, deadline_s=5.0, seed=seed,
+                              clock=clock),
+            failure_threshold=4, reset_timeout_s=0.5, reduced_tokens=256)
+        meta = ResilientExecutor(meta, policy, dep="graph.meta")
+        state = ResilientExecutor(state, policy, dep="graph.state")
+        # each slot gets its OWN ladder policy (same constants, shared
+        # retry): degradations is per-incident state reset by
+        # begin_incident — one shared instance across interleaved
+        # machines would cross-wipe annotations (see run_chaos_soak)
+        slot_policies = [policy] + [
+            ResiliencePolicy(retry=policy.retry,
+                             failure_threshold=policy.failure_threshold,
+                             reset_timeout_s=policy.reset_timeout_s,
+                             reduced_tokens=policy.reduced_tokens)
+            for _ in range(concurrency - 1)]
+
+    cfg = RCAConfig(locator_max_new_tokens=192, cypher_max_new_tokens=96,
+                    analyzer_max_new_tokens=96, fresh_threads=True)
+    if rca_overrides:
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, **rca_overrides)
+    if not cfg.fresh_threads:
+        # refused even at concurrency=1: the K=1 leg is the parity
+        # BASELINE, so it must run the same scheduling-invariant prompt
+        # regime the K>1 legs are held to
+        raise ValueError("run_pipelined_sweep requires fresh_threads="
+                         "True: persistent stage threads make prompts "
+                         "depend on incident completion order")
+
+    pipelines = [RCAPipeline(service, meta, state, cfg,
+                             resilience=slot_policies[i])
+                 for i in range(concurrency)]
+
+    obs_ctx: Any = contextlib.nullcontext()
+    if tracer is not None:
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        tracer.clock = clock          # virtual timestamps, like the soak
+        obs_ctx = obs_trace.tracing(tracer)
+
+    messages = (list(incidents) if incidents is not None
+                else [INCIDENTS[i % len(INCIDENTS)].message
+                      for i in range(n_incidents)])
+
+    sched = SweepScheduler(pipelines)
+    with obs_ctx:
+        results = sched.run(messages)
+
+    if journal is not None:
+        live_journal = getattr(service, "_journal", None)
+        if live_journal is not None:
+            live_journal.close()
+
+    rows: List[Dict[str, Any]] = []
+    n_resolved = n_degraded = n_failed = 0
+    for message, result in zip(messages, results):
+        if isinstance(result, IncidentFailure):
+            rows.append({"error_message": message, "status": "failed",
+                         "error": result.error})
+            n_failed += 1
+            continue
+        row = _incident_row(message, result)
+        # the per-incident flight digest is scheduling-dependent (it sees
+        # the tracer mid-sweep) — stats territory, never report territory
+        row.pop("flight", None)
+        # carry the decoded artifacts too: byte-identity then attests
+        # actual greedy decode parity, not just structural agreement
+        row["token_usage"] = result.get("token_usage")
+        for ra, a in zip(row["analyses"], result.get("analysis", [])):
+            ra["cypher_query"] = a.get("human_cypher_query",
+                                       a.get("cypher_query"))
+            ra["reports"] = [sp.get("report")
+                             for sp in a.get("statepath", [])]
+        if row["status"] == "degraded":
+            n_degraded += 1
+        else:
+            n_resolved += 1
+        rows.append(row)
+
+    report: Dict[str, Any] = {
+        "seed": seed,
+        "backend": backend,
+        "n_incidents": len(messages),
+        "completed": n_resolved + n_degraded,
+        "resolved": n_resolved,
+        "degraded": n_degraded,
+        "failed": n_failed,
+        "incidents": rows,
+    }
+    if router is not None and engines:
+        engines = [r.backend.engine for r in router.replicas.values()
+                   if getattr(r.backend, "engine", None) is not None]
+    if engines:
+        # same bar as the chaos soak: the sweep must leave every engine
+        # drained with allocator invariants intact
+        clean = True
+        for eng in engines:
+            eng.allocator.check()
+            resident = (eng.prefix_cache.n_resident
+                        if eng.prefix_cache else 0)
+            clean = clean and bool(
+                not eng.has_work
+                and eng.allocator.n_free + resident
+                == eng.engine_cfg.num_pages - 1)
+        report["engine_clean"] = clean
+    if router is not None:
+        report["cluster_replicas"] = cluster_replicas
+
+    stats: Dict[str, Any] = dict(sched.stats.snapshot())
+    stats["concurrency"] = concurrency
+    if policy is not None:
+        # ladder counters accumulate per SLOT policy; the sums are
+        # interleaving-invariant even though the split across slots isn't
+        snap = policy.snapshot()
+        for p in slot_policies[1:]:
+            for k, v in p.counters.items():
+                snap["counters"][k] = snap["counters"].get(k, 0) + v
+        stats["policy"] = snap
+    if tracer is not None:
+        stats["flight"] = tracer.flight_summary()
+    return {"report": report, "stats": stats, "service": service,
+            "engines": engines, "router": router}
 
 
 def run_overload_soak(seed: int = 0, n_runs: int = 100, spill: bool = True,

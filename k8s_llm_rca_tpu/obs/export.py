@@ -104,6 +104,7 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                                     "d2h_syncs": s.d2h_syncs,
                                     "dispatches": s.dispatches,
                                     "prefill_chunks": s.prefill_chunks,
+                                    "idle_ticks": s.idle_ticks,
                                     "cluster_queue_depth":
                                     s.cluster_queue_depth,
                                     "cluster_occupancy":
@@ -288,6 +289,8 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
             counts.get("engine.prefix_promoted_pages", 0.0)
         gauges["engine_prefix_bytes_restored"] = \
             counts.get("engine.prefix_bytes_restored", 0.0)
+        gauges["engine_idle_ticks"] = \
+            counts.get("engine.idle_ticks", 0.0)
         # per-priority pending depth (guard: stub engines in tests queue
         # bare objects without a priority attribute)
         crit = norm = batch = 0

@@ -78,6 +78,11 @@ class TickSample:
     prefix_demotions: float = 0.0
     prefix_promoted_pages: float = 0.0
     prefix_bytes_restored: float = 0.0
+    # pipelined sweep (serve/backend.py): cumulative pumps that found
+    # live handles but nothing decodable — the WAITED ticks the sweep
+    # scheduler exists to eliminate (docs/performance.md "Pipelined
+    # sweep")
+    idle_ticks: float = 0.0
 
 
 class TickTimeline:

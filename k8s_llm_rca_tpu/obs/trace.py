@@ -66,6 +66,12 @@ SITES = frozenset({
     # warm L1/L2 match without re-prefill
     "engine.prefix_demote",
     "engine.prefix_promote",
+    # pipelined sweep (serve/backend.py pump idle branch + the scheduler
+    # in rca/scheduler.py): pumps that found live handles but nothing
+    # decodable, and the park interval between a stage submitting its run
+    # and the scheduler resuming that incident's machine
+    "engine.idle_ticks",
+    "rca.stage.queue_wait",
     # serve layer
     "serve.run_started",
     "serve.run",

@@ -266,16 +266,22 @@ def submit_semantic(state_node, error_message: str,
     return service.create_run(sub.id, analyzer.assistant.id)
 
 
-def await_semantic(run, analyzer: GenericAssistant) -> str:
-    """Barrier for one submit_semantic run: wait, return its reply text."""
-    service = analyzer.service
-    run = service.wait_run(run.id)
+def parse_semantic(run, analyzer: GenericAssistant) -> str:
+    """Parse half of ``await_semantic``: the run is already terminal (the
+    caller waited, or the incident state machine was resumed on it)."""
     if run.status != "completed":
         raise RuntimeError(f"analyzer run ended in state {run.status}")
+    service = analyzer.service
     for m in service.list_messages(run.thread_id).data:
         if m.id == run.response_message_id:
             return m.content[0].text.value
     raise RuntimeError(f"reply message for run {run.id} not found")
+
+
+def await_semantic(run, analyzer: GenericAssistant) -> str:
+    """Barrier for one submit_semantic run: wait, return its reply text."""
+    run = analyzer.service.wait_run(run.id)
+    return parse_semantic(run, analyzer)
 
 
 def _missing_state_clue(entity_kind: str, entity_id: str,
@@ -482,3 +488,135 @@ def check_statepath(query_executor, analyzer: GenericAssistant,
             f"analyzer run ended in state {analyzer.get_run_status().status}")
     report = messages.data[0].content[0].text.value
     return report, path_clues
+
+
+def check_statepath_steps(query_executor, analyzer: GenericAssistant,
+                          statepath, concurrent: bool = True, reranker=None,
+                          fields_top_k: int = 0):
+    """Generator twin of ``check_statepath``: identical stage logic and
+    identical prompts/evidence order, but every LLM round-trip YIELDS its
+    pending Run instead of blocking in ``wait_run``.  The caller resumes
+    the generator once the yielded run is terminal (``drive_steps`` does
+    so by waiting — the sequential scheduling; the sweep scheduler polls
+    and interleaves other incidents' stages in the meantime).  Runs are
+    yielded one at a time in the exact order the blocking path waits on
+    them, so failure ordering and straggler cancellation are unchanged.
+    ``StopIteration.value`` is the blocking path's (report, path_clues).
+
+    ``concurrent`` keeps its meaning: the fan-out still SUBMITS every
+    audit run up front (the engine decodes them in one batch) — only the
+    per-run settle points yield."""
+    timestamp = error_message = None
+    for ele in statepath:
+        if _is_node(ele) and ele["kind"] == "Event":
+            timestamp = ele["timestamp"]
+            error_message = ele["message"]
+    if timestamp is None:
+        raise ValueError("statepath record has no Event node")
+
+    path_clues: Dict[str, List[str]] = {}
+    kinds: List[str] = []
+    fanout: List[Tuple[str, List[Any]]] = []   # (label, clues | pending runs)
+    for ele in statepath:
+        if not _is_node(ele):
+            continue
+        if ele["kind2"] == "Event" or ele["kind"] == "Event":
+            continue
+        if ele["kind"] == "EVENT":
+            continue
+        entity_kind = entity.entity_kind(ele)
+        entity_id = ele["id"]
+        kinds.append(entity_kind)
+        label = f"{entity_kind}({entity_id})"
+        if not concurrent:
+            # serial: one round-trip per entity on the MAIN analyzer
+            # thread, in path order (check_states_of_entity's shape —
+            # the reference's serial order, with the wait externalized)
+            records = query_executor.run_query(
+                find_strict_states(entity_kind, entity_id, timestamp))
+            clues: List[str] = []
+            if not records:
+                clue = _missing_state_clue(entity_kind, entity_id,
+                                           query_executor)
+                clues.append(clue)
+                analyzer.add_message(clue)   # evidence for the summary run
+            else:
+                for record in records:
+                    state_node = record["n2"]
+                    fields = _project_fields(state_node, error_message,
+                                             reranker, fields_top_k)
+                    analyzer.add_message(_semantic_prompt(
+                        state_node, error_message, fields))
+                    analyzer.run_assistant()
+                    run = analyzer.run
+                    yield run
+                    semantic = parse_semantic(run, analyzer)
+                    clues.append(
+                        f"{state_node['kind'].upper()}({state_node['id']}):"
+                        f" {semantic}")
+            for clue in clues:
+                log.info("clue: %s", clue)
+            path_clues[label] = clues
+            continue
+        # fan-out: same as the blocking path — submit without waiting
+        try:
+            records = query_executor.run_query(
+                find_strict_states(entity_kind, entity_id, timestamp))
+            if not records:
+                clue = _missing_state_clue(entity_kind, entity_id,
+                                           query_executor)
+                fanout.append((label, [("clue", clue)]))
+            else:
+                items: List[Any] = []
+                fanout.append((label, items))
+                for record in records:
+                    run = submit_semantic(record["n2"], error_message,
+                                          analyzer, reranker, fields_top_k)
+                    items.append(("run", record["n2"], run))
+        except Exception:
+            _cancel_fanout_runs(analyzer, fanout)
+            raise
+
+    # barrier: yield each pending run in path order (the order the
+    # blocking path waits on them); evidence posts at the barrier
+    try:
+        for label, items in fanout:
+            clues = []
+            for item in items:
+                if item[0] == "clue":
+                    clues.append(item[1])
+                else:
+                    _, state_node, run = item
+                    yield run
+                    semantic = parse_semantic(run, analyzer)
+                    clues.append(f"{state_node['kind'].upper()}"
+                                 f"({state_node['id']}): {semantic}")
+            for clue in clues:
+                analyzer.add_message(clue)
+                log.info("clue: %s", clue)
+            path_clues[label] = clues
+    except Exception:
+        _cancel_fanout_runs(analyzer, fanout)
+        raise
+
+    prompt = (
+        f"Based on the previous analysis of {', '.join(kinds)}, summarize "
+        "the root cause of the error message and pinpoint the most relevant "
+        "parts.  For each kind give a relevance score (0-10).  Provide a "
+        "resolution with a kubectl or bash command where applicable, using "
+        "the actual resource names and namespaces for precision.  Include "
+        "crucial details (resource names, IDs, numbers).\n" + REPORT_SHAPE)
+    analyzer.add_message(prompt)
+    reporter = getattr(analyzer, "reporter", None)
+    service = analyzer.service
+    if reporter is not None:
+        run = service.create_run(analyzer.thread.id, reporter.assistant.id)
+        yield run
+        return parse_semantic(run, analyzer), path_clues
+    analyzer.run_assistant()
+    run = analyzer.run
+    yield run
+    if run.status != "completed":
+        raise RuntimeError(f"analyzer run ended in state {run.status}")
+    from k8s_llm_rca_tpu.serve.api import run_reply_text
+    return run_reply_text(service, run), path_clues

@@ -23,7 +23,9 @@ from __future__ import annotations
 from typing import Any, Dict, List
 
 from k8s_llm_rca_tpu.rca import entity
-from k8s_llm_rca_tpu.serve.api import AssistantService, GenericAssistant
+from k8s_llm_rca_tpu.serve.api import (
+    AssistantService, GenericAssistant, Run, RunStatus, run_reply_text,
+)
 from k8s_llm_rca_tpu.serve.backend import GenOptions
 from k8s_llm_rca_tpu.utils.fenced import extract_cypher
 from k8s_llm_rca_tpu.utils.logging import get_logger
@@ -143,9 +145,13 @@ def cypher_query_schema(metapath_str: str, error_message: str
     return {"type": "choice", "options": variants}
 
 
-def generate_cypher_query(metapath_str: str, error_message: str,
-                          generator: GenericAssistant,
-                          constrain: bool = True) -> str:
+def submit_cypher_query(metapath_str: str, error_message: str,
+                        generator: GenericAssistant,
+                        constrain: bool = True) -> Run:
+    """Submit half of ``generate_cypher_query``: post the request (with
+    the per-metapath skeleton grammar when constrained) and start the run
+    WITHOUT waiting.  The incident state machine yields the Run and parses
+    on settle; the blocking wrapper waits in between."""
     prompt = f"""\
 Use generation-template-1 to generate a cypher query for the following case.
 Strictly follow the (srcKind)-[rel]->(destKind) ordering, never reverse it.
@@ -170,13 +176,26 @@ the error message to filtering is:
             max_new_tokens=max(generator.assistant.gen.max_new_tokens,
                                budget))
     generator.run_assistant(gen=gen)
-    messages = generator.wait_get_last_k_message(1)
-    if messages is None:
-        raise RuntimeError(
-            f"cypher run ended in state {generator.get_run_status().status}")
-    query = extract_cypher(messages.data[0].content[0].text.value)
+    return generator.run
+
+
+def parse_cypher_query(generator: GenericAssistant, run: Run) -> str:
+    """Parse half: extract the fenced query from the settled run's reply.
+    Same RuntimeError text as the blocking path on non-completed runs."""
+    if run.status != RunStatus.COMPLETED:
+        raise RuntimeError(f"cypher run ended in state {run.status}")
+    query = extract_cypher(run_reply_text(generator.service, run))
     log.info("generated cypher query:\n%s", query)
     return query
+
+
+def generate_cypher_query(metapath_str: str, error_message: str,
+                          generator: GenericAssistant,
+                          constrain: bool = True) -> str:
+    run = submit_cypher_query(metapath_str, error_message, generator,
+                              constrain)
+    generator.service.wait_run(run.id)
+    return parse_cypher_query(generator, run)
 
 
 # ---------------------------------------------------------------------------

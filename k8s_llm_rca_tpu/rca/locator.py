@@ -22,7 +22,9 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from k8s_llm_rca_tpu.serve.api import AssistantService, GenericAssistant
+from k8s_llm_rca_tpu.serve.api import (
+    AssistantService, GenericAssistant, Run, RunStatus, run_reply_text,
+)
 from k8s_llm_rca_tpu.serve.backend import GenOptions
 from k8s_llm_rca_tpu.utils.fenced import extract_json
 from k8s_llm_rca_tpu.utils.logging import get_logger
@@ -159,18 +161,38 @@ def build_prompt_template(native_kinds: Sequence[str],
         external=", ".join(external_kinds)) + PROMPT_TEMPLATE_TASK
 
 
-def find_destKind_relevantResources(
-        error_message: str, src_kind: str, prompt_template: str,
-        locator: GenericAssistant) -> Dict[str, Any]:
+def submit_destKind_plan(error_message: str, src_kind: str,
+                         prompt_template: str,
+                         locator: GenericAssistant) -> Run:
+    """Submit half of ``find_destKind_relevantResources``: post the plan
+    prompt and start the run WITHOUT waiting.  The pipelined incident
+    state machine yields the returned Run and resumes on
+    ``parse_destKind_plan`` once it settles; the blocking wrapper below
+    just waits in between — one code path, two schedulings."""
     prompt = prompt_template.format(error_message=error_message,
                                     involved_object=src_kind)
     locator.add_message(prompt)
     locator.run_assistant()
-    messages = locator.wait_get_last_k_message(1)
-    if messages is None:
-        raise RuntimeError(
-            f"locator run ended in state {locator.get_run_status().status}")
-    return extract_json(messages.data[0].content[0].text.value)
+    return locator.run
+
+
+def parse_destKind_plan(locator: GenericAssistant, run: Run
+                        ) -> Dict[str, Any]:
+    """Parse half: read the settled run's reply and extract the plan JSON.
+    Raises the same RuntimeError text as the blocking path on any
+    non-completed terminal state."""
+    if run.status != RunStatus.COMPLETED:
+        raise RuntimeError(f"locator run ended in state {run.status}")
+    return extract_json(run_reply_text(locator.service, run))
+
+
+def find_destKind_relevantResources(
+        error_message: str, src_kind: str, prompt_template: str,
+        locator: GenericAssistant) -> Dict[str, Any]:
+    run = submit_destKind_plan(error_message, src_kind, prompt_template,
+                               locator)
+    locator.service.wait_run(run.id)
+    return parse_destKind_plan(locator, run)
 
 
 # ---------------------------------------------------------------------------

@@ -15,10 +15,23 @@ one reduced-token-budget attempt -> scripted-oracle fallback -> annotated
 partial result — and the incident dict carries a ``degraded`` list naming
 every rung drop.  Without one, behavior is exactly the reference-faithful
 fail-fast control flow above.
+
+The incident is a **resumable state machine**: ``incident_steps`` is a
+generator that SUBMITS every LLM run and yields it instead of blocking in
+``wait_run``.  ``analyze_incident`` drives the generator sequentially
+(``serve.api.drive_steps`` waits on each yielded run — byte-identical to
+the historical blocking control flow); the sweep scheduler
+(rca/scheduler.py) drives K incidents' generators interleaved over one
+shared engine pump, so incident B's prefill admits while incident A's
+audits decode.  Greedy outputs depend only on per-thread message history
+(serve.api.render_prompt), so the two schedulings produce byte-identical
+reports — scheduling is latency-only.
 """
 
 from __future__ import annotations
 
+import contextlib
+import inspect
 import json
 import time
 from dataclasses import dataclass, field
@@ -28,7 +41,7 @@ from k8s_llm_rca_tpu.config import RCAConfig, SweepConfig
 from k8s_llm_rca_tpu.graph.executor import CypherSyntaxError
 from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.rca import auditor, cyphergen, locator
-from k8s_llm_rca_tpu.serve.api import AssistantService
+from k8s_llm_rca_tpu.serve.api import AssistantService, drive_steps
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 
 log = get_logger(__name__)
@@ -89,13 +102,23 @@ class RCAPipeline:
 
     def plan_destination(self, error_message: str, src_kind: str
                          ) -> (Dict[str, Any], int):
-        """destKind planning with retry-with-feedback (test_all.py:63-83)."""
+        """destKind planning with retry-with-feedback (test_all.py:63-83).
+        Blocking driver of ``_plan_steps`` — one code path for both
+        schedulings."""
+        return drive_steps(self._plan_steps(error_message, src_kind),
+                           self.service)
+
+    def _plan_steps(self, error_message: str, src_kind: str):
+        """Step generator for destKind planning: submit, YIELD the pending
+        run, parse on resume — retry-with-feedback preserved verbatim."""
         last_err: Optional[Exception] = None
         for attempt in range(self.cfg.locator_max_attempts):
             try:
-                plan = locator.find_destKind_relevantResources(
+                run = locator.submit_destKind_plan(
                     error_message, src_kind, self.prompt_template,
                     self.locator)
+                yield run
+                plan = locator.parse_destKind_plan(self.locator, run)
                 plan["DestinationKind"]   # missing keys retry with feedback,
                                           # like the reference's in-try dict
                                           # access (test_all.py:63-83)
@@ -125,8 +148,13 @@ class RCAPipeline:
         budget (resilience.reduced_tokens).  The same schema grammar still
         applies, so a budget below its minimal document raises BudgetError
         immediately and the ladder falls through to the scripted rung."""
+        return drive_steps(self._plan_reduced_steps(error_message, src_kind),
+                           self.service)
+
+    def _plan_reduced_steps(self, error_message: str, src_kind: str):
         import dataclasses as _dc
 
+        from k8s_llm_rca_tpu.serve.api import RunStatus, run_reply_text
         from k8s_llm_rca_tpu.utils.fenced import extract_json
 
         gen = _dc.replace(self.locator.assistant.gen,
@@ -135,12 +163,12 @@ class RCAPipeline:
                                              involved_object=src_kind)
         self.locator.add_message(prompt)
         self.locator.run_assistant(gen=gen)
-        messages = self.locator.wait_get_last_k_message(1)
-        if messages is None:
+        run = self.locator.run
+        yield run
+        if run.status != RunStatus.COMPLETED:
             raise RuntimeError(
-                f"reduced-budget locator run ended in state "
-                f"{self.locator.get_run_status().status}")
-        plan = extract_json(messages.data[0].content[0].text.value)
+                f"reduced-budget locator run ended in state {run.status}")
+        plan = extract_json(run_reply_text(self.service, run))
         plan["DestinationKind"]        # missing key -> next rung
         return plan, 1
 
@@ -149,7 +177,14 @@ class RCAPipeline:
     def compile_and_run(self, metapath_str: str, error_message: str,
                         analysis: Dict[str, Any]) -> List[Any]:
         """Cypher generation with retries + deterministic fallback
-        (test_all.py:99-131).  Mutates ``analysis`` with attempt metadata."""
+        (test_all.py:99-131).  Mutates ``analysis`` with attempt metadata.
+        Blocking driver of ``_cypher_steps``."""
+        return drive_steps(
+            self._cypher_steps(metapath_str, error_message, analysis),
+            self.cypher_generator.service)
+
+    def _cypher_steps(self, metapath_str: str, error_message: str,
+                      analysis: Dict[str, Any]):
         from k8s_llm_rca_tpu.serve.backend import BudgetError
 
         records: List[Any] = []
@@ -158,9 +193,12 @@ class RCAPipeline:
         attempt = 0
         for attempt in range(self.cfg.cypher_max_attempts):
             try:
-                cypher_query = cyphergen.generate_cypher_query(
+                run = cyphergen.submit_cypher_query(
                     metapath_str, error_message, self.cypher_generator,
                     constrain=self.cfg.constrained)
+                yield run
+                cypher_query = cyphergen.parse_cypher_query(
+                    self.cypher_generator, run)
                 records = cyphergen.run_and_filter_query(
                     self.state_executor, cypher_query)
                 generated_ok = True
@@ -200,13 +238,104 @@ class RCAPipeline:
 
     # ------------------------------------------------------------ pipeline
 
-    def analyze_incident(self, error_message: str) -> IncidentResult:
+    def analyze_incident(self, error_message: str,
+                         usage_by_runs: bool = False) -> IncidentResult:
         """One incident end-to-end; returns the batch-driver result dict
         (schema of test_with_file.py:67-204).  With a tracer active
         (obs/trace.py) the incident runs under an ``rca.incident`` span
         with per-stage child spans, and the result dict carries a compact
-        ``flight`` summary of everything recorded while it ran."""
+        ``flight`` summary of everything recorded while it ran.
+
+        Blocking driver of ``incident_steps`` — the exact code the sweep
+        scheduler interleaves, scheduled sequentially.  ``usage_by_runs``
+        switches token accounting from the reference's wall-clock window
+        to exact attribution by the run ids this incident created (the
+        window double-counts when incidents overlap in time — the
+        pipelined sweep always uses exact attribution, on BOTH legs of a
+        parity comparison)."""
+        return drive_steps(
+            self.incident_steps(error_message, usage_by_runs=usage_by_runs),
+            self.service)
+
+    @contextlib.contextmanager
+    def _stage_span(self, name: str, pipelined: bool, **args):
+        """Stage bracketing that survives generator suspension.  The
+        sequential driver keeps the historical context-manager span
+        (thread-local parentage intact).  Under the scheduler a span held
+        open across a yield would corrupt the tracer's thread-local stack
+        (machines interleave on ONE thread), so the pipelined path records
+        an explicit-times span after the fact (Tracer.add_span — the
+        serve.run pattern)."""
+        if not pipelined:
+            with obs_trace.span(name, cat="rca", **args):
+                yield
+            return
+        tr = obs_trace.active()
+        t0 = tr.now() if tr is not None else 0.0
+        try:
+            yield
+        finally:
+            tr = obs_trace.active()
+            if tr is not None:
+                tr.add_span(name, t0, tr.now(), cat="rca", args=dict(args))
+
+    def _ladder_steps(self, stage: str, rungs):
+        """Generator twin of ResiliencePolicy.ladder (faults/policy.py:
+        219-237): same rung order, same degradation bookkeeping, same
+        terminal raise — but a rung returning a generator is delegated to,
+        so its pending runs yield through to the driver."""
+        from k8s_llm_rca_tpu.faults.policy import StageDegradation
+
+        res = self.resilience
+        last: Optional[BaseException] = None
+        for i, (name, fn) in enumerate(rungs):
+            try:
+                out = fn()
+                if inspect.isgenerator(out):
+                    out = yield from out
+            except Exception as e:  # noqa: BLE001 — each rung may fail
+                log.warning("stage %s rung %s failed: %s", stage, name, e)
+                last = e
+                continue
+            if i > 0:
+                res.degradations.append(
+                    StageDegradation(stage, name, str(last)))
+                res.counters["degraded_stages"] += 1
+                obs_trace.event("resilience.degraded", stage=stage,
+                                rung=name)
+            return out
+        raise last if last is not None else RuntimeError(
+            f"stage {stage}: empty ladder")
+
+    def _track(self, gen, run_ids: List[str]):
+        """yield-from with run-id capture: every Run the inner step
+        generator yields is recorded, giving the incident the exact set of
+        run ids it created for ``usage_for_runs`` attribution."""
+        try:
+            pending = next(gen)
+            while True:
+                run_ids.append(pending.id)
+                yield pending
+                pending = gen.send(None)
+        except StopIteration as stop:
+            return stop.value
+
+    def incident_steps(self, error_message: str,
+                       usage_by_runs: bool = False,
+                       pipelined: bool = False):
+        """Resumable incident state machine: locate -> metapath -> per-
+        metapath cypher -> per-record audits, with the retry-with-feedback
+        loops and resilience-ladder rungs intact — every LLM step SUBMITS
+        its run and yields it instead of waiting.  The caller resumes the
+        generator once the yielded run is terminal; ``StopIteration.value``
+        is the incident result dict.
+
+        ``pipelined`` only changes how stage spans are recorded (explicit
+        times instead of a context manager held across yields — see
+        ``_stage_span``); the submitted prompts, and therefore greedy
+        outputs, are identical under both schedulings."""
         t0 = time.time()
+        run_ids: List[str] = []
         if self.cfg.fresh_threads:
             self.reset_threads()
         res = self.resilience
@@ -216,41 +345,44 @@ class RCAPipeline:
         tracer = obs_trace.active()
         mark = tracer.mark() if tracer is not None else None
         with METRICS.timer("rca.incident"), \
-                obs_trace.span("rca.incident", cat="rca",
-                               incident=error_message[:60]):
+                self._stage_span("rca.incident", pipelined,
+                                 incident=error_message[:60]):
             # stage 1 runs the degradation ladder under a resilience
             # policy: full engine run (which already retries with
             # feedback) -> ONE reduced-budget attempt -> scripted-oracle
             # plan -> (srcKind only) the Pod default.  Every rung drop is
             # annotated in result["degraded"].
             with METRICS.timer("rca.stage.locate"), \
-                    obs_trace.span("rca.stage.locate", cat="rca"):
+                    self._stage_span("rca.stage.locate", pipelined):
                 if res is None:
                     src_kind = locator.find_srcKind(self.state_executor,
                                                     error_message)
-                    plan, attempts = self.plan_destination(error_message,
-                                                           src_kind)
+                    plan, attempts = yield from self._track(
+                        self._plan_steps(error_message, src_kind), run_ids)
                 else:
                     from k8s_llm_rca_tpu.rca.oracle import scripted_plan
 
-                    src_kind = res.ladder("locate.srcKind", [
-                        ("full", lambda: locator.find_srcKind(
-                            self.state_executor, error_message)),
-                        # the stategraph is down/degraded: Pod is the kind
-                        # every incident fixture's Event hangs off, the
-                        # least wrong starting point a blind planner can
-                        # pick
-                        ("default-Pod", lambda: "Pod"),
-                    ])
-                    plan, attempts = res.ladder("locate.plan", [
-                        ("full", lambda: self.plan_destination(
-                            error_message, src_kind)),
-                        ("reduced-budget", lambda: self._plan_reduced(
-                            error_message, src_kind)),
-                        ("scripted-oracle", lambda: (scripted_plan(
-                            error_message, src_kind, self.native_kinds,
-                            self.external_kinds), 0)),
-                    ])
+                    src_kind = yield from self._track(
+                        self._ladder_steps("locate.srcKind", [
+                            ("full", lambda: locator.find_srcKind(
+                                self.state_executor, error_message)),
+                            # the stategraph is down/degraded: Pod is the
+                            # kind every incident fixture's Event hangs
+                            # off, the least wrong starting point a blind
+                            # planner can pick
+                            ("default-Pod", lambda: "Pod"),
+                        ]), run_ids)
+                    plan, attempts = yield from self._track(
+                        self._ladder_steps("locate.plan", [
+                            ("full", lambda: self._plan_steps(
+                                error_message, src_kind)),
+                            ("reduced-budget", lambda:
+                             self._plan_reduced_steps(error_message,
+                                                      src_kind)),
+                            ("scripted-oracle", lambda: (scripted_plan(
+                                error_message, src_kind, self.native_kinds,
+                                self.external_kinds), 0)),
+                        ]), run_ids)
             result["locator_attempts"] = attempts
 
             dest_kind = plan["DestinationKind"]
@@ -265,14 +397,15 @@ class RCAPipeline:
                     self.cfg.metapath_max_hops)
 
             with METRICS.timer("rca.stage.metapath"), \
-                    obs_trace.span("rca.stage.metapath", cat="rca"):
+                    self._stage_span("rca.stage.metapath", pipelined):
                 if res is None:
                     metapaths = _metapaths()
                 else:
-                    metapaths = res.ladder("locate.metapath", [
-                        ("full", _metapaths),
-                        ("skipped", lambda: []),
-                    ])
+                    metapaths = yield from self._track(
+                        self._ladder_steps("locate.metapath", [
+                            ("full", _metapaths),
+                            ("skipped", lambda: []),
+                        ]), run_ids)
 
             result["analysis"] = []
             for metapath in metapaths:
@@ -280,17 +413,19 @@ class RCAPipeline:
                     metapath)
                 analysis: Dict[str, Any] = {"extend_metapath": metapath_str}
                 with METRICS.timer("rca.stage.cypher"), \
-                        obs_trace.span("rca.stage.cypher", cat="rca",
-                                       metapath=metapath_str[:60]):
+                        self._stage_span("rca.stage.cypher", pipelined,
+                                         metapath=metapath_str[:60]):
                     if res is None:
-                        records = self.compile_and_run(
-                            metapath_str, error_message, analysis)
+                        records = yield from self._track(
+                            self._cypher_steps(metapath_str, error_message,
+                                               analysis), run_ids)
                     else:
-                        records = res.ladder("cypher", [
-                            ("full", lambda: self.compile_and_run(
-                                metapath_str, error_message, analysis)),
-                            ("skipped", lambda: []),
-                        ])
+                        records = yield from self._track(
+                            self._ladder_steps("cypher", [
+                                ("full", lambda: self._cypher_steps(
+                                    metapath_str, error_message, analysis)),
+                                ("skipped", lambda: []),
+                            ]), run_ids)
                 if self.reranker is not None and len(records) > 1:
                     top_k = self.cfg.rerank_top_k or None
                     ranked = self.reranker.rerank_records(
@@ -299,23 +434,26 @@ class RCAPipeline:
                     analysis["rerank_scores"] = [s for _, s in ranked]
                 analysis["statepath"] = []
                 for record in records:
-                    def _audit(record=record):
-                        return auditor.check_statepath(
+                    def _audit_steps(record=record):
+                        return auditor.check_statepath_steps(
                             self.state_executor, self.analyzer, record,
                             concurrent=self.cfg.concurrent_audits,
                             reranker=self.reranker,
                             fields_top_k=self.cfg.rerank_fields_top_k)
 
                     with METRICS.timer("rca.stage.audit"), \
-                            obs_trace.span("rca.stage.audit", cat="rca"):
+                            self._stage_span("rca.stage.audit", pipelined):
                         if res is None:
-                            report, clues = _audit()
+                            report, clues = yield from self._track(
+                                _audit_steps(), run_ids)
                         else:
-                            report, clues = res.ladder("audit", [
-                                ("full", _audit),
-                                ("skipped", lambda: (
-                                    None, {"degraded": "audit skipped"})),
-                            ])
+                            report, clues = yield from self._track(
+                                self._ladder_steps("audit", [
+                                    ("full", _audit_steps),
+                                    ("skipped", lambda: (
+                                        None,
+                                        {"degraded": "audit skipped"})),
+                                ]), run_ids)
                     analysis["statepath"].append(
                         {"report": report, "clue": clues})
                 result["analysis"].append(analysis)
@@ -324,7 +462,13 @@ class RCAPipeline:
             result["degraded"] = res.incident_snapshot()
         t1 = time.time()
         result["time_cost"] = t1 - t0
-        result["token_usage"] = self.window_token_usage(int(t0), int(t1) + 1)
+        if usage_by_runs:
+            # exact attribution by the run ids THIS incident created —
+            # scheduling-invariant, so pipelined == sequential byte-wise
+            result["token_usage"] = self.service.usage_for_runs(run_ids)
+        else:
+            result["token_usage"] = self.window_token_usage(
+                int(t0), int(t1) + 1)
         if tracer is not None:
             # compact flight-recorder digest of everything recorded while
             # THIS incident ran (spans/events/ticks since the mark) — the

@@ -281,6 +281,47 @@ class AssistantService:
         return self.runs[run_id]
 
     @_locked
+    def poll_run(self, run_id: str) -> Run:
+        """Non-blocking probe: advance the backend by ONE pump and return
+        the run, terminal or not.  This is the future-style half of the
+        run API — ``wait_run`` spins this in a loop; a sweep scheduler
+        calls it once per slot visit and interleaves other incidents'
+        stages while the run decodes (the reference's 5 s ``sleep`` poll,
+        common/openai_generic_assistant.py:92-115, with the sleep deleted
+        and the wait externalized)."""
+        self._pump()
+        return self.runs[run_id]
+
+    @_locked
+    def pump_once(self) -> None:
+        """Public single pump: advance the backend one tick and settle any
+        finished runs, without reference to a particular run.  The sweep
+        scheduler's shared pump loop calls this when every in-flight
+        incident is blocked on an unsettled run — one tick decodes ALL of
+        them (the continuous batcher doesn't care which caller pumps)."""
+        self._pump()
+
+    @_locked
+    def reap_dropped_run(self, run_id: str) -> Run:
+        """Settle a non-terminal run whose backend no longer tracks its
+        handle — the ``_wait_run_loop`` 'backend dropped the run' path,
+        exposed for non-blocking pollers: the sweep scheduler cannot sit
+        inside ``wait_run`` (it has other incidents to advance), so it
+        applies the same liveness check between pumps.  Unlike the wait
+        loop this also drops the handle from ``_inflight``, so a later
+        deadline sweep in ``_pump`` cannot flip the FAILED run to
+        EXPIRED."""
+        run = self.runs[run_id]
+        if (run.status not in RunStatus.TERMINAL
+                and not self.backend.busy(run.backend_handle)):
+            run.status = RunStatus.FAILED
+            run.error = "backend dropped the run"
+            self._inflight.pop(run.backend_handle, None)
+            if self._journal is not None:
+                self._journal_settle(run)
+        return run
+
+    @_locked
     def cancel_run(self, run_id: str) -> Run:
         run = self.runs[run_id]
         if run.status not in RunStatus.TERMINAL:
@@ -355,6 +396,23 @@ class AssistantService:
             if (run.completed_at is not None
                     and tmin <= run.created_at < tmax
                     and tmin <= run.completed_at < tmax):
+                for k in usage:
+                    usage[k] += run.usage[k]
+        return usage
+
+    @_locked
+    def usage_for_runs(self, run_ids: Sequence[str]) -> Dict[str, int]:
+        """Exact usage attribution: sum the usage of precisely the named
+        runs (terminal only — in-flight usage is still moving).  The
+        wall-clock window of ``assistant_token_usage`` double-counts when
+        incidents overlap in time (pipelined sweeps); summing by the run
+        ids an incident actually created cannot.  Same 3-key schema as the
+        reference's windowed accounting."""
+        usage = {"prompt_tokens": 0, "completion_tokens": 0,
+                 "total_tokens": 0}
+        for rid in run_ids:
+            run = self.runs.get(rid)
+            if run is not None and run.status in RunStatus.TERMINAL:
                 for k in usage:
                     usage[k] += run.usage[k]
         return usage
@@ -490,6 +548,34 @@ class AssistantService:
             if self._waiters > 1:
                 time.sleep(0.001)
         return run
+
+
+def drive_steps(gen, service: AssistantService):
+    """Run a step generator (rca/pipeline.py::incident_steps and friends)
+    to completion by BLOCKING on each yielded run — the sequential
+    scheduling of the exact code the sweep scheduler (rca/scheduler.py)
+    interleaves.  ``StopIteration.value`` is the generator's result.
+    Exceptions raised inside the generator (failed runs are detected at
+    the parse halves) propagate unchanged."""
+    try:
+        pending = next(gen)
+        while True:
+            service.wait_run(pending.id)
+            pending = gen.send(None)
+    except StopIteration as stop:
+        return stop.value
+
+
+def run_reply_text(service: AssistantService, run: Run) -> str:
+    """Reply text of a COMPLETED run, located by its response_message_id
+    (robust to concurrent runs settling interleaved on a shared thread —
+    the same disambiguation ``wait_get_last_k_message`` applies).  The
+    parse halves of the split stage functions (rca/locator.py,
+    rca/cyphergen.py) read their settled runs through this."""
+    for m in service.list_messages(run.thread_id).data:
+        if m.id == run.response_message_id:
+            return m.content[0].text.value
+    raise RuntimeError(f"reply message for run {run.id} not found")
 
 
 class GenericAssistant:

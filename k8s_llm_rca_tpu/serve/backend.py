@@ -229,6 +229,14 @@ class EngineBackend:
             # DETERMINISTIC number of pumps instead of wall seconds
             inject._ARMED.clock.sleep(0.05)
         if not self.engine.has_work:
+            if self._live:
+                # pumped with live handles but nothing decodable: every
+                # live run is stalled (injected fault) or orphaned.  Count
+                # it so sweep timelines show WAITED ticks, not just busy
+                # ones (registered obs site; TickSample.idle_ticks picks
+                # the counter up on the next real tick).
+                self.engine._count("engine.idle_ticks")
+                obs_trace.event("engine.idle_ticks", live=len(self._live))
             return results
         for res in self.engine.step():
             handle = self._seq_to_handle.pop(res.seq_id, None)

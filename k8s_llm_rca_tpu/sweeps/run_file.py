@@ -137,12 +137,29 @@ def main(argv=None) -> dict:
                              "concurrent incidents' runs merge into shared "
                              "continuous-batching decode ticks (per-chip "
                              "batching; --replicas scales across chips)")
+    parser.add_argument("--concurrency", type=int, default=1,
+                        help="K incidents in flight on ONE engine via the "
+                             "single-threaded pipelined sweep scheduler "
+                             "(rca/scheduler.py): async run submission + "
+                             "shared pump, deterministic interleave, "
+                             "byte-identical outputs to the sequential "
+                             "sweep under greedy (requires "
+                             "--fresh-threads)")
     args = parser.parse_args(argv)
     if args.replicas > 1 and args.workers > 1:
         parser.error("--replicas and --workers are mutually exclusive: "
                      "replicas build one engine per device, workers share "
                      "one engine (use replicas x workers via one process "
                      "per device if both are wanted)")
+    if args.concurrency > 1 and (args.replicas > 1 or args.workers > 1):
+        parser.error("--concurrency is the single-threaded pipelined "
+                     "scheduler over ONE engine; it composes with neither "
+                     "--replicas (engine per device) nor --workers "
+                     "(thread per incident)")
+    if args.concurrency > 1 and not args.fresh_threads:
+        parser.error("--concurrency > 1 requires --fresh-threads: "
+                     "interleaved incidents on persistent stage threads "
+                     "would make prompts depend on completion order")
 
     if not os.path.exists(args.input):
         log.info("input %s missing; writing the built-in corpus", args.input)
@@ -176,7 +193,11 @@ def main(argv=None) -> dict:
     os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
     start = time.time()
     n_rep = max(1, args.replicas)
-    if args.workers > 1:
+    sweep_sched = None
+    if args.concurrency > 1:
+        costs, failures, per_replica, sweep_sched = _drain_pipelined(
+            args, messages, args.concurrency)
+    elif args.workers > 1:
         costs, failures, per_replica = _drain_shared(args, messages,
                                                      args.workers)
     elif n_rep == 1:
@@ -198,6 +219,8 @@ def main(argv=None) -> dict:
         summary["replicas"] = per_replica
     if args.workers > 1:
         summary["workers"] = args.workers
+    if sweep_sched is not None:
+        summary["sweep_sched"] = sweep_sched
     print(json.dumps({k: v for k, v in summary.items() if k != "metrics"}))
     return summary
 
@@ -240,6 +263,51 @@ def _drain_serial(args, messages):
     pipeline.meta_executor.close()
     pipeline.state_executor.close()
     return costs, failures, None
+
+
+def _drain_pipelined(args, messages, k):
+    """Pipelined sweep: K incidents in flight on ONE service via the
+    single-threaded ``SweepScheduler`` (rca/scheduler.py) — each pipeline
+    submits its next LLM run and yields, the scheduler pumps the shared
+    engine once per quiescent round, so one incident's decode overlaps
+    another's graph work.  Unlike --workers there are no threads and no
+    completion-order nondeterminism: results come back in input order and
+    (under greedy + --fresh-threads) are byte-identical to --concurrency 1.
+    Records are appended at sweep end, in input order."""
+    from k8s_llm_rca_tpu.rca.scheduler import IncidentFailure, SweepScheduler
+
+    service = build_service(args)       # ONE engine, shared by all slots
+    executors = [build_executors(args) for _ in range(k)]
+    pipelines = [
+        RCAPipeline(
+            service, meta, state, RCAConfig(model=args.model,
+                      fresh_threads=True),
+            sweep=SweepConfig(input_csv=args.input,
+                              output_json=args.output))
+        for meta, state in executors]
+    sched = SweepScheduler(pipelines)
+    t0 = time.time()
+    results = sched.run(messages)
+    elapsed = time.time() - t0
+    costs, failures = [], 0
+    with open(args.output, "a") as f:
+        for message, result in zip(messages, results):
+            if isinstance(result, IncidentFailure):
+                log.warning("incident failed: %s", result.error)
+                record = {"error_message": message,
+                          "error": str(result.error)}
+                failures += 1
+            else:
+                record = result
+            # interleaved incidents share wall time, so per-incident
+            # time_cost is not observable here; report the amortized cost
+            record.setdefault("time_cost", elapsed / max(1, len(messages)))
+            costs.append(record["time_cost"])
+            f.write(json.dumps(record, indent=4) + "\n")
+    for meta, state in executors:
+        meta.close()
+        state.close()
+    return costs, failures, None, sched.stats.snapshot()
 
 
 def _drain_shared(args, messages, n_workers):

@@ -578,6 +578,29 @@ class TestSiteCoverage:
         assert tier_c.get("engine.prefix_demotions", 0) > 0
         assert tier_c.get("engine.prefix_hits_l1", 0) > 0
 
+        # (8) pipelined-sweep sites: a 2-in-flight oracle sweep parks
+        # machines on the shared pump (rca.stage.queue_wait spans from
+        # rca/scheduler.py), and a pump with a live-but-orphaned handle
+        # on a drained engine counts an idle tick (serve/backend.py)
+        from k8s_llm_rca_tpu.faults.soak import run_pipelined_sweep
+
+        tr_sweep = Tracer()
+        tracers.append(tr_sweep)
+        run_pipelined_sweep(n_incidents=2, backend="oracle",
+                            concurrency=2, tracer=tr_sweep)
+        assert "rca.stage.queue_wait" in tr_sweep.emitted_names()
+
+        tr_idle = Tracer(clock=VirtualClock())
+        tracers.append(tr_idle)
+        with obs_trace.tracing(tr_idle):
+            idle_backend = EngineBackend(engine)
+            idle_backend.start("node notready", GenOptions(max_new_tokens=2))
+            while engine.has_work:     # drain around the backend: the
+                engine.step()          # handle stays live, nothing decodable
+            idle_backend.pump()
+        assert "engine.idle_ticks" in tr_idle.emitted_names()
+        assert (engine._counts or {}).get("engine.idle_ticks", 0) > 0
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:

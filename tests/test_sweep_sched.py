@@ -1,0 +1,386 @@
+"""Pipelined cross-incident sweep scheduler (rca/scheduler.py + the
+plan-free sweep driver faults/soak.py::run_pipelined_sweep) — ISSUE 11.
+
+The acceptance bar is BYTE-IDENTITY: the pipelined sweep's report (per-
+incident statuses, degradation annotations, attempt counts, decoded
+cypher/audit text, exact run-id-attributed token usage) must serialize to
+the same bytes at every concurrency, because greedy decode is batch-
+invariant and the scheduler's interleave never reaches the prompts
+(``fresh_threads``).  Everything scheduling-dependent (pump counts,
+inflight samples, queue-wait spans) lives in ``out["stats"]`` and is
+asserted separately.
+
+Oracle-backed sweeps are sub-second at n=100, so the 100-incident
+acceptance run is tier-1; engine-backed parity runs one small pair, and
+the composition matrix (prefix cache x host overlap x chunked prefill x
+speculative decode) is ``slow``-marked.
+"""
+
+import copy
+
+import pytest
+
+from k8s_llm_rca_tpu.config import RCAConfig
+from k8s_llm_rca_tpu.faults import inject
+from k8s_llm_rca_tpu.faults.plan import FaultPlan, VirtualClock
+from k8s_llm_rca_tpu.faults.soak import (
+    _build_oracle_service, default_plan_spec, report_bytes, run_chaos_soak,
+    run_pipelined_sweep,
+)
+from k8s_llm_rca_tpu.graph import InMemoryGraphExecutor
+from k8s_llm_rca_tpu.graph.fixtures import (
+    INCIDENTS, build_metagraph, build_stategraph,
+)
+from k8s_llm_rca_tpu.rca import RCAPipeline
+from k8s_llm_rca_tpu.rca.scheduler import IncidentFailure, SweepScheduler
+
+pytestmark = pytest.mark.sweep
+
+# matches no Event node -> the locator's deterministic retry-with-feedback
+# path exhausts and the incident fails the same way at every concurrency
+BOGUS = "flux capacitor underflow in warp nacelle {}"
+
+# an armed-but-EMPTY plan: from_spec treats a falsy spec as "use the
+# default chaos mix", so the no-fault spec must be truthy — a site with
+# no indices and no rate samples zero faults (plan.has_faults False)
+NOOP_SPEC = {"noop.site": {}}
+
+
+def _oracle_stack(n_pipelines=1, fresh_threads=True, service=None):
+    """n slot pipelines over one oracle service (soak constants)."""
+    clock = VirtualClock()
+    if service is None:
+        service, _, _ = _build_oracle_service(1.5, clock)
+    cfg = RCAConfig(locator_max_new_tokens=192, cypher_max_new_tokens=96,
+                    analyzer_max_new_tokens=96,
+                    fresh_threads=fresh_threads)
+    pipelines = [
+        RCAPipeline(service,
+                    InMemoryGraphExecutor(build_metagraph()),
+                    InMemoryGraphExecutor(build_stategraph()), cfg)
+        for _ in range(n_pipelines)]
+    return service, pipelines
+
+
+def _mixed_messages(n_good=6, n_bogus=2):
+    """Corpus incidents with deterministic failures interleaved."""
+    msgs = [INCIDENTS[i % len(INCIDENTS)].message for i in range(n_good)]
+    for j in range(n_bogus):
+        msgs.insert(1 + 2 * j, BOGUS.format(j))
+    return msgs
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Never leak an armed plan into other tests."""
+    yield
+    if inject.active() is not None:
+        inject.disarm()
+
+
+# ---------------------------------------------------------------------------
+# loud exclusions: every composition whose outputs would depend on
+# scheduling must refuse with ValueError, not silently diverge
+# ---------------------------------------------------------------------------
+
+
+class TestExclusions:
+    def test_concurrency_zero_refused(self):
+        with pytest.raises(ValueError, match="concurrency must be >= 1"):
+            run_pipelined_sweep(backend="oracle", concurrency=0)
+
+    def test_empty_pipeline_list_refused(self):
+        with pytest.raises(ValueError, match="at least one pipeline"):
+            SweepScheduler([])
+
+    def test_reused_pipeline_refused(self):
+        _, (p,) = _oracle_stack(1)
+        with pytest.raises(ValueError, match="OWN RCAPipeline"):
+            SweepScheduler([p, p])
+
+    def test_disjoint_services_refused(self):
+        _, (p1,) = _oracle_stack(1)
+        _, (p2,) = _oracle_stack(1)
+        with pytest.raises(ValueError, match="ONE AssistantService"):
+            SweepScheduler([p1, p2])
+
+    def test_shared_threads_refused_above_one(self):
+        service, pipelines = _oracle_stack(2, fresh_threads=False)
+        with pytest.raises(ValueError, match="fresh_threads=True"):
+            SweepScheduler(pipelines)
+
+    def test_shared_threads_refused_even_at_k1_in_driver(self):
+        # the K=1 leg is the parity BASELINE, so the driver holds it to
+        # the same prompt regime as the K>1 legs
+        with pytest.raises(ValueError, match="fresh_threads"):
+            run_pipelined_sweep(backend="oracle", concurrency=1,
+                                rca_overrides={"fresh_threads": False})
+
+    def test_armed_faulted_plan_refused_above_one(self):
+        service, pipelines = _oracle_stack(2)
+        plan = FaultPlan.from_spec(0, default_plan_spec(),
+                                   clock=VirtualClock())
+        assert plan.has_faults
+        sched = SweepScheduler(pipelines)
+        with inject.armed(plan):
+            with pytest.raises(ValueError, match="concurrency > 1"):
+                sched.run([INCIDENTS[0].message] * 2)
+
+    def test_armed_empty_plan_allowed_above_one(self):
+        service, pipelines = _oracle_stack(2)
+        plan = FaultPlan.from_spec(0, NOOP_SPEC, clock=VirtualClock())
+        assert not plan.has_faults
+        with inject.armed(plan):
+            results = SweepScheduler(pipelines).run(
+                [INCIDENTS[0].message, INCIDENTS[1].message])
+        assert all(not isinstance(r, IncidentFailure) for r in results)
+
+    def test_chaos_soak_faulted_plan_refused_above_one(self):
+        with pytest.raises(ValueError, match="concurrency > 1"):
+            run_chaos_soak(seed=0, n_incidents=2, backend="oracle",
+                           concurrency=2)
+
+    def test_chaos_soak_boundary_machinery_refused_above_one(self):
+        # supervisor/killer/selfheal all poll once per incident BOUNDARY,
+        # which a pipelined sweep does not have
+        for kw in ({"supervisor": object()}, {"killer": object()},
+                   {"selfheal": True}):
+            with pytest.raises(ValueError, match="BOUNDARY"):
+                run_chaos_soak(seed=0, n_incidents=2,
+                               backend="cluster-oracle",
+                               plan_spec=NOOP_SPEC, concurrency=2, **kw)
+
+    def test_engine_overrides_need_engine_backend(self):
+        for backend in ("oracle", "cluster-oracle"):
+            with pytest.raises(ValueError, match="engine_overrides"):
+                run_pipelined_sweep(backend=backend, concurrency=1,
+                                    engine_overrides={"prefix_cache": True})
+
+
+# ---------------------------------------------------------------------------
+# oracle parity: the cheap backend proves the SCHEDULER invariant
+# (prompt/interleave independence) at every concurrency
+# ---------------------------------------------------------------------------
+
+
+class TestOracleParity:
+    def test_byte_identity_mixed_incidents_k_1_4_16(self):
+        """Corpus incidents with deterministic failures interleaved:
+        failed rows (error strings included) must also be byte-stable."""
+        msgs = _mixed_messages(n_good=6, n_bogus=2)
+        outs = {k: run_pipelined_sweep(backend="oracle", concurrency=k,
+                                       incidents=msgs)
+                for k in (1, 4, 16)}
+        blobs = {k: report_bytes(o["report"]) for k, o in outs.items()}
+        assert blobs[4] == blobs[1]
+        assert blobs[16] == blobs[1]
+        rep = outs[1]["report"]
+        assert rep["failed"] == 2
+        assert rep["completed"] == 6
+        statuses = [r["status"] for r in rep["incidents"]]
+        assert statuses.count("failed") == 2
+        # evidence the K>1 legs actually interleaved (stats, not report)
+        assert outs[4]["stats"]["inflight_max"] > 1
+        assert outs[16]["stats"]["pumps"] < outs[1]["stats"]["pumps"]
+
+    def test_byte_identity_with_resilience_ladder(self):
+        out1 = run_pipelined_sweep(backend="oracle", concurrency=1,
+                                   n_incidents=8, resilience=True)
+        out4 = run_pipelined_sweep(backend="oracle", concurrency=4,
+                                   n_incidents=8, resilience=True)
+        assert report_bytes(out1["report"]) == report_bytes(out4["report"])
+        # ladder counters are summed across slot policies in stats —
+        # interleaving-invariant totals even though the split is not
+        assert out4["stats"]["policy"]["counters"] \
+            == out1["stats"]["policy"]["counters"]
+
+    def test_hundred_incident_acceptance_twice_over(self):
+        """The ISSUE 11 bar: a seeded 100-incident pipelined sweep,
+        byte-identical to sequential AND to its own rerun."""
+        out1 = run_pipelined_sweep(backend="oracle", concurrency=1,
+                                   n_incidents=100)
+        outa = run_pipelined_sweep(backend="oracle", concurrency=16,
+                                   n_incidents=100)
+        outb = run_pipelined_sweep(backend="oracle", concurrency=16,
+                                   n_incidents=100)
+        b1, ba, bb = (report_bytes(o["report"])
+                      for o in (out1, outa, outb))
+        assert ba == b1
+        assert bb == ba
+        assert out1["report"]["completed"] == 100
+        assert outa["stats"]["inflight_max"] == 16
+        assert outa["stats"]["inflight_mean"] > 8
+
+    def test_scheduler_k1_matches_blocking_driver(self):
+        """The scheduler at K=1 drives the SAME generator the blocking
+        path does — results must match field for field (wall-clock cost
+        excluded)."""
+        msgs = [i.message for i in INCIDENTS[:3]]
+        _, (p_sched,) = _oracle_stack(1)
+        sched_results = SweepScheduler([p_sched]).run(msgs)
+        _, (p_block,) = _oracle_stack(1)
+        for msg, got in zip(msgs, sched_results):
+            want = p_block.analyze_incident(msg, usage_by_runs=True)
+            got, want = copy.deepcopy(got), copy.deepcopy(want)
+            got.pop("time_cost", None)
+            want.pop("time_cost", None)
+            assert got == want
+
+
+# ---------------------------------------------------------------------------
+# cluster routing composes (oracle replicas; engine replicas are slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.cluster
+class TestClusterOracleParity:
+    def test_byte_identity_k1_vs_k4(self):
+        out1 = run_pipelined_sweep(backend="cluster-oracle", concurrency=1,
+                                   n_incidents=8)
+        out4 = run_pipelined_sweep(backend="cluster-oracle", concurrency=4,
+                                   n_incidents=8)
+        assert report_bytes(out1["report"]) == report_bytes(out4["report"])
+        assert out1["report"]["cluster_replicas"] == 2
+        assert out4["stats"]["inflight_max"] > 1
+
+
+# ---------------------------------------------------------------------------
+# durability: the journal records the interleaved truth and recovery
+# agrees with the live service
+# ---------------------------------------------------------------------------
+
+
+class TestJournalAgreement:
+    @staticmethod
+    def _max_inflight_depth(path):
+        """Max submitted-but-unsettled depth in journal record order."""
+        from k8s_llm_rca_tpu.serve.journal import read_journal
+
+        records, _ = read_journal(path)
+        depth = peak = 0
+        for rec in records:
+            if rec.get("kind") == "run_submit":
+                depth += 1
+                peak = max(peak, depth)
+            elif rec.get("kind") == "run_settle":
+                depth -= 1
+        return peak
+
+    def test_journal_interleaves_and_recovery_agrees(self, tmp_path):
+        import os
+
+        from k8s_llm_rca_tpu.rca.oracle import OracleBackend
+        from k8s_llm_rca_tpu.serve.recover import recover_service
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        d1, d4 = str(tmp_path / "k1"), str(tmp_path / "k4")
+        out1 = run_pipelined_sweep(backend="oracle", concurrency=1,
+                                   n_incidents=6, durable_dir=d1)
+        out4 = run_pipelined_sweep(backend="oracle", concurrency=4,
+                                   n_incidents=6, durable_dir=d4)
+        assert report_bytes(out1["report"]) == report_bytes(out4["report"])
+
+        # the WAL is the scheduling truth: at K=4 strictly more runs sit
+        # submitted-but-unsettled than the K=1 incident ever holds
+        p1 = self._max_inflight_depth(os.path.join(d1, "serve.wal"))
+        p4 = self._max_inflight_depth(os.path.join(d4, "serve.wal"))
+        assert p4 > p1
+
+        # replay onto a fresh backend: every run settled before close, so
+        # nothing is resubmitted and every status agrees with the live
+        # service the sweep returned
+        svc = out4["service"]
+        recovered, rep = recover_service(
+            os.path.join(d4, "serve.wal"),
+            OracleBackend(get_tokenizer()))
+        assert rep["resubmitted"] == []
+        assert set(recovered.runs) == set(svc.runs)
+        for rid, run in recovered.runs.items():
+            assert run.status == svc.runs[rid].status
+
+
+# ---------------------------------------------------------------------------
+# chaos soak at K>1: legal exactly when the armed plan is EMPTY, and then
+# byte-identical to the sequential soak (poll counters included)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestChaosSoakEmptyPlan:
+    def test_byte_identity_k1_vs_k2(self):
+        r1 = run_chaos_soak(seed=0, n_incidents=4, backend="oracle",
+                            plan_spec=NOOP_SPEC, concurrency=1)
+        r2 = run_chaos_soak(seed=0, n_incidents=4, backend="oracle",
+                            plan_spec=NOOP_SPEC, concurrency=2)
+        assert report_bytes(r1) == report_bytes(r2)
+        # the armed plan's per-site poll sums are in the report — setup
+        # polls (pipeline construction) must NOT scale with concurrency
+        assert r1["faults"]["polls"] == r2["faults"]["polls"]
+        assert r1["failed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine parity: the real paged TINY engine, sized for tier-1 (one
+# compile shape, 3 incidents); the composition matrix is slow-marked
+# ---------------------------------------------------------------------------
+
+
+class TestEngineParity:
+    def test_byte_identity_k1_vs_k3(self):
+        out1 = run_pipelined_sweep(backend="engine", concurrency=1,
+                                   n_incidents=3)
+        out3 = run_pipelined_sweep(backend="engine", concurrency=3,
+                                   n_incidents=3)
+        assert report_bytes(out1["report"]) == report_bytes(out3["report"])
+        assert out1["report"]["engine_clean"] is True
+        assert out3["report"]["engine_clean"] is True
+        assert out1["report"]["failed"] == 0
+        # exact run-id usage attribution rides the report (satellite 1)
+        usage = out1["report"]["incidents"][0]["token_usage"]
+        assert usage["total_tokens"] \
+            == usage["prompt_tokens"] + usage["completion_tokens"] > 0
+        # interleaving shrinks the pump count (the whole point)
+        assert out3["stats"]["pumps"] < out1["stats"]["pumps"]
+
+
+@pytest.mark.slow
+class TestEngineCompositionMatrix:
+    """Every greedy-exact engine feature must compose with the pipelined
+    sweep WITHOUT moving a byte of the report: same baseline, one feature
+    flipped per leg, all at K=3 vs the plain K=1 baseline."""
+
+    OVERRIDES = (
+        {"prefix_cache": True},
+        {"host_overlap": True},
+        {"prefill_chunk_budget": 64},   # page-aligned: sweep page_size=64
+        {"speculative_k": 3},
+    )
+
+    def test_features_keep_byte_identity(self):
+        from k8s_llm_rca_tpu.utils.logging import METRICS
+
+        baseline = run_pipelined_sweep(backend="engine", concurrency=1,
+                                       n_incidents=3)
+        base_bytes = report_bytes(baseline["report"])
+        for ov in self.OVERRIDES:
+            drafted0 = METRICS.count("engine.spec_drafted")
+            out = run_pipelined_sweep(backend="engine", concurrency=3,
+                                      n_incidents=3, engine_overrides=ov)
+            assert report_bytes(out["report"]) == base_bytes, ov
+            assert out["report"]["engine_clean"] is True, ov
+            if "speculative_k" in ov:
+                # the n-gram drafter actually ran (satellite 2): accepted
+                # drafts are what keep the byte-identity non-vacuous
+                drafted = METRICS.count("engine.spec_drafted") - drafted0
+                accepted = METRICS.count("engine.spec_accepted")
+                assert drafted > 0
+                assert accepted > 0
+
+    def test_cluster_engine_byte_identity(self):
+        out1 = run_pipelined_sweep(backend="cluster", concurrency=1,
+                                   n_incidents=2)
+        out2 = run_pipelined_sweep(backend="cluster", concurrency=2,
+                                   n_incidents=2)
+        assert report_bytes(out1["report"]) == report_bytes(out2["report"])
+        assert out1["report"]["engine_clean"] is True
+        assert out2["report"]["engine_clean"] is True
