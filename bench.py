@@ -952,6 +952,84 @@ def bench_selfheal(n_runs: int = 8, max_new: int = 24):
             "runs": n_runs}
 
 
+def bench_proc_cluster(n_pings: int = 30, n_runs: int = 8):
+    """Out-of-process replica leg (cluster/proc.py): one fresh
+    interpreter, four measurements, each measurement-or-null.
+
+    Workers are scripted echo backends on CPU (they never touch the
+    tunnel), so every number here is LOCAL pipe/process cost — the one
+    family of wall-clock measurement the host rules trust unreservedly:
+    the tunnel's memoization and ~0.25 s dispatch latency cannot touch a
+    stdin/stdout RPC.
+
+    - ``spawn_s``: wall-clock from ``Popen`` to the validated ready
+      handshake (interpreter boot + serving-stack import), mean over the
+      fleet's initial spawns.
+    - ``rpc_roundtrip_p50_ms``: p50 of ``n_pings`` ping round-trips on
+      one live worker — distinct payloads (the pipe has no memoizer, but
+      keeping them distinct mirrors the engine-leg discipline).
+    - ``failover_recovery_s``: wall-clock from a REAL SIGKILL delivered
+      mid-flight to every in-flight run settled on survivors AND the
+      fleet healed back to N (hard-evidence detection -> failover ->
+      actual process restart).
+    - ``killed_restarts``: exact count of supervisor restarts during the
+      kill scenario (count-exact, like ``selfheal`` restarts).
+    """
+    import time
+
+    from k8s_llm_rca_tpu.cluster import (
+        ClusterRouter, HealthPolicy, HealthWatchdog, ReplicaSupervisor,
+    )
+    from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
+    from k8s_llm_rca_tpu.serve.backend import GenOptions
+
+    replicas = build_proc_replicas(2, kind="echo", echo_delay_pumps=2)
+    try:
+        spawns = [r.backend.spawn_s for r in replicas
+                  if r.backend.spawn_s is not None]
+        spawn_s = round(sum(spawns) / len(spawns), 4) if spawns else None
+
+        lat = []
+        for i in range(n_pings):
+            t0 = time.perf_counter()
+            replicas[0].backend._rpc("ping", probe=i)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        rpc_p50_ms = round(lat[len(lat) // 2] * 1000.0, 4) if lat else None
+
+        router = ClusterRouter(replicas)
+        wd = HealthWatchdog(HealthPolicy(miss_budget=1,
+                                         hung_tick_threshold=2))
+        sup = ReplicaSupervisor()
+        router.attach_health(wd, sup)
+        handles = [router.start(f"bench run {i}", GenOptions())
+                   for i in range(n_runs)]
+        victim = max(router.alive_ids(),
+                     key=lambda r: (router.replicas[r].queue_depth(), r))
+        t0 = time.perf_counter()
+        router.replicas[victim].kill_process()
+        out = {}
+        for _ in range(256):
+            out.update(router.pump())
+            if (all(h in out for h in handles)
+                    and all(r.healthy()
+                            for r in router.replicas.values())):
+                break
+        healed = (all(h in out for h in handles)
+                  and all(v.error is None for v in out.values())
+                  and len(router.alive_ids()) == 2)
+        recovery_s = (round(time.perf_counter() - t0, 4)
+                      if healed else None)
+        restarts = len(sup.restarts) if healed else None
+    finally:
+        for r in replicas:
+            r.close()
+    return {"spawn_s": spawn_s,
+            "rpc_roundtrip_p50_ms": rpc_p50_ms,
+            "failover_recovery_s": recovery_s,
+            "killed_restarts": restarts}
+
+
 def bench_host_overlap(n_prompts: int = 48, max_batch: int = 8,
                        prompt_len: int = 64, max_new: int = 32):
     """Overlapped-hot-loop leg (docs/performance.md): the TINY paged
@@ -1235,6 +1313,7 @@ def main():
     overload = _leg("bench.bench_overload()", timeout=1500) or {}
     selfheal = _leg("bench.bench_selfheal()", timeout=1500) or {}
     prefix_tiers = _leg("bench.bench_prefix_leg()", timeout=1500) or {}
+    proc_cluster = _leg("bench.bench_proc_cluster()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -1425,6 +1504,17 @@ def main():
         "prefix_warmstart_prefill_dispatches_saved": prefix_tiers.get(
             "warmstart_prefill_dispatches_saved"),
         "prefix_disk_restore_s": prefix_tiers.get("disk_restore_s"),
+        # out-of-process replicas (cluster/proc.py): CPU echo workers on
+        # local pipes, so these are pure process/RPC wall-clock numbers
+        # the tunnel cannot memoize — spawn-to-ready, ping round-trip
+        # p50, SIGKILL-to-healed recovery, and the exact supervisor
+        # restart count; null when the leg failed — schema stays stable
+        "proc_spawn_s": proc_cluster.get("spawn_s"),
+        "proc_rpc_roundtrip_p50_ms": proc_cluster.get(
+            "rpc_roundtrip_p50_ms"),
+        "proc_failover_recovery_s": proc_cluster.get(
+            "failover_recovery_s"),
+        "proc_killed_restarts": proc_cluster.get("killed_restarts"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

@@ -17,12 +17,19 @@ Layer map (PARITY.md §cluster, docs/cluster.md):
   (``router.attach_health``): deterministic ALIVE -> SUSPECT -> DEAD
   liveness from tick/pump heartbeats, in-tree failover on DEAD,
   restart-and-rejoin on the original submesh, and poison-run
-  quarantine after ``quarantine_after`` fatal incarnations.
+  quarantine after ``quarantine_after`` fatal incarnations;
+- ``proc.ProcReplica`` / ``proc.build_proc_replicas`` — out-of-process
+  replicas: each backend runs in its own OS process (spawned with the
+  bench.py per-leg env recipe) behind the length-prefixed CRC-framed
+  wire protocol (``wire.py``); the watchdog's liveness verdicts gain
+  hard OS evidence (pipe EOF / exit codes) and the supervisor's
+  ``rebuild`` restarts the actual process.
 """
 
 from k8s_llm_rca_tpu.cluster.health import (ALIVE, DEAD, SUSPECT,
                                             HealthPolicy, HealthWatchdog,
                                             ReplicaSupervisor)
+from k8s_llm_rca_tpu.cluster.proc import ProcReplica, build_proc_replicas
 from k8s_llm_rca_tpu.cluster.replica import (EngineReplica, Replica,
                                              build_replicas)
 from k8s_llm_rca_tpu.cluster.router import (ClusterRouter,
@@ -34,4 +41,5 @@ __all__ = [
     "ClusterRouter", "RouterAdmissionError",
     "HealthPolicy", "HealthWatchdog", "ReplicaSupervisor",
     "ALIVE", "SUSPECT", "DEAD",
+    "ProcReplica", "build_proc_replicas",
 ]

@@ -130,6 +130,7 @@ class HealthWatchdog:
         self._detected_t: Dict[int, float] = {}
         self._last_eval: Optional[float] = None
         self.detections: List[int] = []         # rid per DEAD verdict
+        self.hard_detections: List[int] = []    # subset with OS evidence
         self.mttd_s: List[float] = []           # last beat -> verdict
 
     def _now(self) -> float:
@@ -175,6 +176,26 @@ class HealthWatchdog:
         """Clock time of ``rid``'s latest DEAD verdict (MTTR's t0)."""
         return self._detected_t.get(rid)
 
+    def _declare_dead(self, rid: int, now: float, misses: int,
+                      evidence: Optional[str] = None) -> None:
+        self._states[rid] = DEAD
+        self._detected_t[rid] = now
+        self.detections.append(rid)
+        if evidence is not None:
+            self.hard_detections.append(rid)
+        t0 = self._beat_t.get(rid, now)
+        self.mttd_s.append(max(0.0, now - t0))
+        METRICS.inc("cluster.deaths_detected")
+        obs_trace.event("cluster.health", replica=rid, state=DEAD,
+                        misses=misses, evidence=evidence)
+        tr = obs_trace._ACTIVE
+        if tr is not None:
+            tr.add_span("cluster.mttd", t0, now, cat="cluster",
+                        args={"replica": rid})
+        log.warning("watchdog: replica %d DEAD after %d missed probes%s",
+                    rid, misses,
+                    f" (hard evidence: {evidence})" if evidence else "")
+
     def probe(self, router) -> List[int]:
         """One probe evaluation; returns the newly-DEAD replica ids.
 
@@ -183,6 +204,15 @@ class HealthWatchdog:
         count (and demotes SUSPECT back to ALIVE).  The first evaluation
         after ``register`` only baselines the signal — startup is never
         a miss.
+
+        Hard evidence (cluster/proc.py ``proc_liveness``: pipe EOF,
+        ``poll()`` exit code, torn frame, missed protocol heartbeat)
+        SHORT-CIRCUITS the miss budget: the OS already rendered the
+        verdict, so the replica escalates one state per probe —
+        ALIVE -> SUSPECT, SUSPECT -> DEAD — regardless of how fresh its
+        last beat looked.  It still passes through SUSPECT (the
+        invariant the router's routing-around contract relies on), but
+        detection latency is 2 probes, not ``hung_tick_threshold``.
         """
         now = self._now()
         p = self.policy
@@ -194,6 +224,22 @@ class HealthWatchdog:
         for rid, replica in router.replicas.items():
             if not replica.alive or self._states.get(rid) == DEAD:
                 continue   # already failed over / awaiting restart
+            liveness = getattr(replica, "proc_liveness", None)
+            evidence = liveness() if liveness is not None else None
+            if evidence is not None:
+                self._miss[rid] = self._miss.get(rid, 0) + 1
+                if self._states.get(rid) == SUSPECT:
+                    self._declare_dead(rid, now, self._miss[rid],
+                                       evidence=evidence)
+                    newly_dead.append(rid)
+                else:
+                    self._states[rid] = SUSPECT
+                    obs_trace.event("cluster.health", replica=rid,
+                                    state=SUSPECT, misses=self._miss[rid],
+                                    evidence=evidence)
+                    log.warning("watchdog: replica %d SUSPECT on hard "
+                                "evidence (%s)", rid, evidence)
+                continue
             sig = self._sig.get(rid)
             if rid not in self._seen:
                 self._seen[rid] = sig
@@ -209,20 +255,7 @@ class HealthWatchdog:
             self._miss[rid] = self._miss.get(rid, 0) + 1
             misses = self._miss[rid]
             if misses >= p.hung_tick_threshold:
-                self._states[rid] = DEAD
-                self._detected_t[rid] = now
-                self.detections.append(rid)
-                t0 = self._beat_t.get(rid, now)
-                self.mttd_s.append(max(0.0, now - t0))
-                METRICS.inc("cluster.deaths_detected")
-                obs_trace.event("cluster.health", replica=rid, state=DEAD,
-                                misses=misses)
-                tr = obs_trace._ACTIVE
-                if tr is not None:
-                    tr.add_span("cluster.mttd", t0, now, cat="cluster",
-                                args={"replica": rid})
-                log.warning("watchdog: replica %d DEAD after %d missed "
-                            "probes", rid, misses)
+                self._declare_dead(rid, now, misses)
                 newly_dead.append(rid)
             elif misses >= p.miss_budget and self._states[rid] == ALIVE:
                 self._states[rid] = SUSPECT

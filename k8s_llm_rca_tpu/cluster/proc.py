@@ -1,0 +1,712 @@
+"""Out-of-process replicas: each replica's backend runs in its OWN
+interpreter, so a real OS-process death (SIGKILL mid-decode) is finally a
+fault the fleet can experience — and survive — in-tree.
+
+Until now every "replica" was an in-process object and the worst a chaos
+plan could do was *pretend* a process died (``Replica.wedge``).  This
+module makes the fault domain real:
+
+- **worker**: ``python -m k8s_llm_rca_tpu.cluster.proc '<spec-json>'``
+  builds one backend (scripted oracle / echo, or a real TINY engine) and
+  serves the framed request/response protocol of cluster/wire.py over
+  its stdin/stdout pipes.  The parent spawns it with the
+  ``__graft_entry__._respawn_clean`` / bench.py per-leg env recipe —
+  ``PYTHONPATH`` REPLACED by the repo root (dropping the axon
+  sitecustomize that would force the tunnel platform at CONFIG level)
+  and ``JAX_PLATFORMS=cpu`` set before any computation — so a worker can
+  never grab the tunnel's chip grant (host rule: one TPU process at a
+  time).
+- **ProcBackend**: the parent-side proxy presenting the exact
+  ``LMBackend`` surface (start/pump/busy/cancel/count_tokens plus the
+  queue_depth/occupancy gauges), so ``ClusterRouter`` plugs it in
+  unchanged.  Every response frame carries the worker's incarnation and
+  a protocol heartbeat; a transport failure (pipe EOF, torn/corrupt
+  frame, RPC timeout, nonzero ``poll()``) is recorded as hard death
+  EVIDENCE — the proxy goes silent instead of raising into the router,
+  and the health watchdog turns silence + evidence into SUSPECT -> DEAD
+  (cluster/health.py), never a hang.
+- **ProcReplica**: a ``Replica`` whose rebuild recipe spawns a fresh OS
+  process (incarnation + 1), so ``ReplicaSupervisor.restart`` restarts
+  the *actual process* and rejoins it.  Recovery is journal-fenced at
+  two levels: orphaned runs re-start on survivors under their original
+  global handles via the router's recorded ``(prompt, opts)`` twin of
+  the run journal (``fail_replica`` + ``inject.readmission``), and every
+  response frame's incarnation is checked so a stale worker's bytes can
+  never be attributed to the new incarnation.
+
+Protocol (one JSON frame per message, cluster/wire.py framing):
+
+  parent -> worker: ``{"op", "id", ...}``; worker -> parent: ``{"id",
+  "inc", "hb", ...}`` (or ``{"err": {"type", "msg"}}``).  Ops: ready
+  (handshake, worker-initiated), ping, start, pump, cancel, snapshot,
+  adopt, drain.  GenOptions cross the wire as serve/journal.py's
+  ``encode_gen`` dicts (grammar as SPEC — compiled FSMs never cross a
+  process boundary); engine state crosses as the JSON-safe
+  ``snapshot_sequences`` export.
+
+Fault-injection parity (the soak byte-identity contract): the armed
+FaultPlan lives in the PARENT, so ProcBackend polls ``SITE_BACKEND`` for
+engine-kind workers exactly where ``EngineBackend.start`` would
+(budget/error/stall, plus the stalled-run virtual-clock sleep in pump) —
+injected runs never reach the worker, mirroring the in-process backend
+where they never reach the engine.  Scripted kinds poll NOTHING, exactly
+like OracleBackend/EchoBackend, which is why the proc-cluster oracle
+soak's report is byte-identical to the in-process cluster-oracle run.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from k8s_llm_rca_tpu.cluster.replica import Replica
+from k8s_llm_rca_tpu.cluster.wire import (
+    FrameReader, WireEOF, WireError, write_frame,
+)
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+# set in every worker's environment; a worker trying to spawn its own
+# proc replicas is refused loudly (nested proc-in-proc)
+WORKER_ENV = "K8S_RCA_PROC_WORKER"
+
+WORKER_KINDS = ("oracle", "echo", "engine")
+
+# engine workers compile their TINY engine before answering the ready
+# handshake; scripted workers only pay the import of the serving stack
+DEFAULT_SPAWN_TIMEOUT_S = 300.0
+DEFAULT_RPC_TIMEOUT_S = 60.0
+
+
+class WorkerError(RuntimeError):
+    """A worker op raised; the error crossed the wire by name/message."""
+
+
+def _repo_root() -> str:
+    """The directory that contains the ``k8s_llm_rca_tpu`` package — the
+    ONLY entry the worker's PYTHONPATH gets (replacing, not extending,
+    the parent's: the axon sitecustomize on the parent's path would
+    force the tunnel platform inside the worker)."""
+    import k8s_llm_rca_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(k8s_llm_rca_tpu.__file__)))
+
+
+def _with_host_device_count(flags: str, n: int) -> str:
+    """XLA_FLAGS with --xla_force_host_platform_device_count pinned to
+    n, replacing any existing (possibly mismatched) value — the
+    __graft_entry__._respawn_clean recipe, reimplemented here because
+    package code must not import the top-level driver."""
+    parts = [p for p in flags.split()
+             if not p.startswith("--xla_force_host_platform_device_count")]
+    parts.append(f"--xla_force_host_platform_device_count={n}")
+    return " ".join(parts)
+
+
+def worker_env(devices: int = 1) -> Dict[str, str]:
+    """The spawn environment: the parent's env with the CPU-platform
+    pins applied BEFORE any computation (CLAUDE.md host rule — see
+    ``_respawn_clean`` and bench.py's per-leg recipe)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _repo_root()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _with_host_device_count(env.get("XLA_FLAGS", ""),
+                                               devices)
+    env[WORKER_ENV] = "1"
+    return env
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+
+def _build_worker_backend(spec: Dict[str, Any]):
+    """Build the worker's backend from its spec.  Returns ``(backend,
+    heartbeat_fn)`` — the heartbeat is the engine's monotonic tick serial
+    for engine workers (so a worker that answers pumps but whose engine
+    never advances is still caught) and a per-pump counter otherwise."""
+    kind = spec.get("kind", "oracle")
+    if kind == "oracle":
+        from k8s_llm_rca_tpu.rca.oracle import OracleBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        backend = OracleBackend(get_tokenizer(),
+                                chaos=spec.get("oracle_chaos"))
+        return backend, None
+    if kind == "echo":
+        from k8s_llm_rca_tpu.serve.backend import EchoBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        backend = EchoBackend(get_tokenizer(),
+                              reply=spec.get("echo_reply"),
+                              delay_pumps=int(spec.get("echo_delay_pumps",
+                                                       0)))
+        return backend, None
+    if kind == "engine":
+        import jax
+
+        # belt and braces: the env pin is authoritative, but re-assert at
+        # CONFIG level before any computation (tests/_distributed_worker.py
+        # discipline) so a future jax cannot lazily re-probe platforms
+        jax.config.update("jax_platforms", "cpu")
+
+        from k8s_llm_rca_tpu.config import TINY, EngineConfig
+        from k8s_llm_rca_tpu.engine import make_engine
+        from k8s_llm_rca_tpu.models import llama
+        from k8s_llm_rca_tpu.serve.backend import EngineBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        # the soak/cluster TINY shape (faults/soak.py
+        # _build_engine_service), one compile bucket, greedy — the
+        # identical-replica invariant: every incarnation of every proc
+        # replica initializes the same params from the same seed, so a
+        # restarted process generates byte-identically to the first
+        cfg = TINY.replace(max_seq_len=2560)
+        ecfg = EngineConfig(max_batch=4, max_seq_len=2560,
+                            prefill_buckets=(2560,),
+                            max_new_tokens=96, temperature=0.0,
+                            paged=True, page_size=64, num_pages=168,
+                            prefix_cache=False, decode_chunk=16)
+        overrides = spec.get("engine_overrides") or {}
+        if overrides:
+            import dataclasses as _dc
+
+            ecfg = _dc.replace(ecfg, **overrides)
+        params = llama.init_params(cfg,
+                                   jax.random.PRNGKey(spec.get("seed", 0)))
+        tok = get_tokenizer(vocab_size=cfg.vocab_size)
+        backend = EngineBackend(make_engine(cfg, ecfg, params, tok,
+                                            use_kernel=False))
+        return backend, (lambda: int(backend.engine.heartbeat))
+    raise ValueError(f"unknown proc worker kind {kind!r}: expected one "
+                     f"of {WORKER_KINDS}")
+
+
+def _result_to_json(res) -> Dict[str, Any]:
+    return {"text": res.text, "completion_tokens": res.completion_tokens,
+            "prompt_tokens": res.prompt_tokens, "error": res.error,
+            "expired": bool(res.expired)}
+
+
+def worker_main(argv: Sequence[str]) -> int:
+    """Serve the wire protocol until a drain frame or stdin EOF.
+
+    The real stdout fd is claimed for frames FIRST and ``sys.stdout`` is
+    repointed at stderr, so a stray ``print`` anywhere in the serving
+    stack garbles a log line instead of a frame.
+    """
+    out = sys.stdout.buffer
+    sys.stdout = sys.stderr
+    if len(argv) != 1:
+        raise SystemExit("usage: python -m k8s_llm_rca_tpu.cluster.proc "
+                         "'<spec-json>'")
+    spec = json.loads(argv[0])
+    inc = int(spec.get("incarnation", 0))
+    rid = int(spec.get("replica_id", 0))
+    # chaos knobs for the wire-failure tests: after N handled requests,
+    # corrupt the stream (garbage bytes, hard exit) or go silent forever
+    # (the missed-protocol-heartbeat path) — deterministic, no signals
+    corrupt_after = spec.get("chaos_corrupt_after")
+    hang_after = spec.get("chaos_hang_after")
+
+    from k8s_llm_rca_tpu.serve.journal import decode_gen
+
+    backend, hb_fn = _build_worker_backend(spec)
+    pumps = 0
+
+    def hb() -> int:
+        return hb_fn() if hb_fn is not None else pumps
+
+    write_frame(out, {"op": "ready", "id": -1, "inc": inc, "pid": os.getpid(),
+                      "kind": spec.get("kind", "oracle"), "hb": hb()})
+    reader = FrameReader(sys.stdin.buffer)
+    handled = 0
+    while True:
+        try:
+            msg = reader.read_frame()
+        except WireEOF:
+            return 0      # parent went away: a worker never outlives it
+        handled += 1
+        if corrupt_after is not None and handled > int(corrupt_after):
+            out.write(b"\x00garbage-not-a-frame\xff\xfe")
+            out.flush()
+            os._exit(3)
+        if hang_after is not None and handled > int(hang_after):
+            while True:
+                time.sleep(3600)
+        op = msg.get("op")
+        reply: Dict[str, Any] = {"id": msg.get("id"), "inc": inc}
+        try:
+            if op == "ping":
+                reply["ok"] = True
+            elif op == "start":
+                reply["handle"] = backend.start(msg["prompt"],
+                                                decode_gen(msg["gen"]))
+            elif op == "pump":
+                pumps += 1
+                results = backend.pump()
+                reply["results"] = {str(h): _result_to_json(r)
+                                    for h, r in results.items()}
+                # Replica.queue_depth's duck typing, worker-side
+                if hasattr(backend, "queue_depth"):
+                    reply["depth"] = int(backend.queue_depth())
+                else:
+                    reply["depth"] = len(getattr(backend, "_live", None)
+                                         or getattr(backend, "_inflight",
+                                                    ()))
+                occ = getattr(backend, "occupancy", None)
+                reply["occupancy"] = float(occ()) if occ else 0.0
+            elif op == "cancel":
+                backend.cancel(int(msg["handle"]))
+                reply["ok"] = True
+            elif op == "snapshot":
+                snap, handles = backend.snapshot_sequences()
+                reply["snap"] = snap
+                reply["handles"] = handles
+            elif op == "adopt":
+                opts = [decode_gen(g) for g in msg["gens"]]
+                reply["handles"] = backend.adopt_sequences(msg["snap"],
+                                                           opts)
+            elif op == "drain":
+                # graceful shutdown: finish nothing, ack, exit 0 — the
+                # parent has already migrated/cancelled what it wanted
+                reply["ok"] = True
+                reply["hb"] = hb()
+                write_frame(out, reply)
+                return 0
+            else:
+                raise ValueError(f"unknown wire op {op!r}")
+        except Exception as e:                # noqa: BLE001 — crosses wire
+            reply = {"id": msg.get("id"), "inc": inc,
+                     "err": {"type": type(e).__name__, "msg": str(e)}}
+        reply["hb"] = hb()
+        write_frame(out, reply)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent side
+# ---------------------------------------------------------------------------
+
+
+class ProcBackend:
+    """Parent-side proxy for one worker process (LMBackend surface).
+
+    Local (synthetic, NEGATIVE) handles exist for runs that never reach
+    the worker: injected-failed/stalled engine-kind runs (the parent
+    polls the armed plan, mirroring EngineBackend.start) and runs routed
+    here after the process died but before the watchdog's verdict
+    (black-holed — exactly like a request on the wire to a dead box; the
+    failover re-start under the same global handle recovers it).
+    """
+
+    def __init__(self, spec: Dict[str, Any],
+                 spawn_timeout_s: float = DEFAULT_SPAWN_TIMEOUT_S,
+                 rpc_timeout_s: float = DEFAULT_RPC_TIMEOUT_S):
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        self.spec = dict(spec)
+        self.kind = self.spec.get("kind", "oracle")
+        if self.kind not in WORKER_KINDS:
+            raise ValueError(f"unknown proc worker kind {self.kind!r}: "
+                             f"expected one of {WORKER_KINDS}")
+        self.incarnation = int(self.spec.get("incarnation", 0))
+        self.replica_id = int(self.spec.get("replica_id", 0))
+        self.rpc_timeout_s = rpc_timeout_s
+        self._ids = itertools.count()
+        # parent-side run mirror: handle -> True (remote) / False (local)
+        self._live: Dict[int, bool] = {}
+        self._local_handles = itertools.count(-1, -1)
+        self._failed: Dict[int, str] = {}     # injected run failures
+        self._stalled: set = set()            # injected stalls
+        self._dead_evidence: Optional[str] = None
+        self._occupancy = 0.0
+        self.last_heartbeat: Optional[int] = None
+        self.rpcs = 0
+        self.spawn_s: Optional[float] = None
+        if self.kind == "engine":
+            # count_tokens stays parent-side (one RPC per usage line
+            # would dominate the protocol); the tokenizer is the
+            # deterministic byte-fallback one, so parent and worker
+            # counts agree exactly
+            from k8s_llm_rca_tpu.config import TINY
+
+            self._tokenizer = get_tokenizer(vocab_size=TINY.vocab_size)
+            # drain/adopt seam, bound per-kind so ``hasattr`` keeps the
+            # router's scripted-replica drain refusal intact
+            self.snapshot_sequences = self._snapshot_sequences
+            self.adopt_sequences = self._adopt_sequences
+        else:
+            self._tokenizer = get_tokenizer()
+        t0 = time.perf_counter()
+        with obs_trace.span("cluster.proc.spawn", cat="cluster",
+                            replica=self.replica_id, kind=self.kind,
+                            incarnation=self.incarnation):
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "k8s_llm_rca_tpu.cluster.proc",
+                 json.dumps(self.spec, sort_keys=True)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                env=worker_env(int(self.spec.get("devices", 1))))
+            self._reader = FrameReader(self._proc.stdout)
+            try:
+                ready = self._reader.read_frame(timeout_s=spawn_timeout_s)
+            except WireError as e:
+                rc = self._proc.poll()
+                self._reap()
+                raise WorkerError(
+                    f"proc replica {self.replica_id} worker failed its "
+                    f"ready handshake (rc={rc}): {e}") from e
+        if ready.get("op") != "ready" or ready.get("inc") != self.incarnation:
+            self._reap()
+            raise WorkerError(
+                f"proc replica {self.replica_id}: bad ready frame {ready!r}")
+        self.pid = int(ready["pid"])
+        self.last_heartbeat = ready.get("hb")
+        self.spawn_s = time.perf_counter() - t0
+        METRICS.inc("cluster.proc_spawns")
+        log.info("proc replica %d: %s worker pid %d up (incarnation %d, "
+                 "%.2fs)", self.replica_id, self.kind, self.pid,
+                 self.incarnation, self.spawn_s)
+
+    # ------------------------------------------------------------ transport
+
+    def _mark_dead(self, evidence: str) -> None:
+        if self._dead_evidence is None:
+            rc = self._proc.poll()
+            if rc is not None:
+                evidence = f"{evidence}; exit:{rc}"
+            self._dead_evidence = evidence
+            METRICS.inc("cluster.proc_deaths_observed")
+            log.warning("proc replica %d: transport down (%s)",
+                        self.replica_id, evidence)
+
+    def proc_liveness(self) -> Optional[str]:
+        """Hard death evidence, or None while the process looks alive.
+        Checks the OS first (``poll()`` sees a SIGKILL before any RPC
+        does) — this is the signal the watchdog's hard-evidence path
+        escalates on (pipe EOF / exit code, not just wedged ticks)."""
+        if self._dead_evidence is not None:
+            return self._dead_evidence
+        rc = self._proc.poll()
+        if rc is not None:
+            self._mark_dead("process exited")
+            return self._dead_evidence
+        return None
+
+    def _rpc(self, op: str, timeout_s: Optional[float] = None,
+             **fields) -> Dict[str, Any]:
+        """One request/response turn.  Raises WorkerError for an error
+        the WORKER reported; raises WireError/OSError for transport
+        death AFTER recording the evidence (callers on the router path
+        catch and go silent; the watchdog owns the verdict)."""
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+        from k8s_llm_rca_tpu.serve.backend import BudgetError
+
+        if self._dead_evidence is not None:
+            raise WireEOF(f"proc replica {self.replica_id} transport "
+                          f"already down: {self._dead_evidence}")
+        req = dict(fields)
+        req["op"] = op
+        req["id"] = next(self._ids)
+        with obs_trace.span("cluster.proc.rpc", cat="cluster", op=op,
+                            replica=self.replica_id):
+            try:
+                write_frame(self._proc.stdin, req)
+                resp = self._reader.read_frame(
+                    timeout_s=(timeout_s if timeout_s is not None
+                               else self.rpc_timeout_s))
+            except (WireError, OSError, ValueError) as e:
+                # ValueError: write to a pipe closed mid-Popen teardown
+                self._mark_dead(f"{op} rpc failed: {type(e).__name__}: {e}")
+                raise
+        self.rpcs += 1
+        if resp.get("inc") != self.incarnation:
+            # incarnation fence: bytes from a stale worker must never be
+            # attributed to this incarnation's runs
+            self._mark_dead(
+                f"fenced: response incarnation {resp.get('inc')!r} != "
+                f"{self.incarnation}")
+            raise WireEOF(self._dead_evidence)
+        if resp.get("id") != req["id"]:
+            self._mark_dead(
+                f"protocol desync: response id {resp.get('id')!r} != "
+                f"{req['id']}")
+            raise WireEOF(self._dead_evidence)
+        if resp.get("hb") is not None:
+            self.last_heartbeat = int(resp["hb"])
+        err = resp.get("err")
+        if err is not None:
+            if err.get("type") == "BudgetError":
+                raise BudgetError(err.get("msg", ""))
+            raise WorkerError(
+                f"proc replica {self.replica_id} worker {op} failed: "
+                f"{err.get('type')}: {err.get('msg')}")
+        return resp
+
+    # -------------------------------------------------------------- backend
+
+    def start(self, prompt: str, opts) -> int:
+        from k8s_llm_rca_tpu.faults import inject
+        from k8s_llm_rca_tpu.serve.backend import BudgetError
+        from k8s_llm_rca_tpu.serve.journal import encode_gen
+
+        if self.kind == "engine":
+            # the armed plan lives in THIS process: poll exactly where
+            # EngineBackend.start would, so injected runs never reach the
+            # worker (and the plan's poll counters match the in-process
+            # cluster run draw for draw)
+            fault = None
+            if inject._ARMED is not None:
+                fault = inject._ARMED.poll(inject.SITE_BACKEND)
+            if fault is not None and fault.kind == "budget":
+                raise BudgetError(
+                    f"injected budget fault at {fault.site}[{fault.index}]: "
+                    f"no valid output exists under this budget")
+            if fault is not None and fault.kind == "error":
+                handle = next(self._local_handles)
+                self._failed[handle] = (
+                    f"injected engine-run failure at "
+                    f"{fault.site}[{fault.index}]")
+                self._live[handle] = False
+                return handle
+            if fault is not None and fault.kind == "stall":
+                handle = next(self._local_handles)
+                self._stalled.add(handle)
+                self._live[handle] = False
+                return handle
+        if self.proc_liveness() is not None:
+            # routed here between the process death and the watchdog's
+            # verdict: black-hole the run like a request on the wire to
+            # a dead box — the failover re-start (same global handle)
+            # recovers it on a survivor
+            handle = next(self._local_handles)
+            self._live[handle] = False
+            return handle
+        try:
+            resp = self._rpc("start", prompt=prompt, gen=encode_gen(opts))
+        except (WireError, OSError):
+            handle = next(self._local_handles)
+            self._live[handle] = False
+            return handle
+        handle = int(resp["handle"])
+        self._live[handle] = True
+        return handle
+
+    def pump(self) -> Dict[int, Any]:
+        from k8s_llm_rca_tpu.faults import inject
+        from k8s_llm_rca_tpu.serve.backend import BackendResult
+
+        results: Dict[int, BackendResult] = {}
+        for handle in list(self._failed):
+            msg = self._failed.pop(handle)
+            if self._live.pop(handle, None) is not None:
+                results[handle] = BackendResult("", 0, error=msg)
+        if self._stalled and inject._ARMED is not None:
+            # EngineBackend.pump's deterministic-deadline discipline: a
+            # stalled run ends only via the serve deadline, which must
+            # arrive after a fixed number of pumps, not wall seconds
+            inject._ARMED.clock.sleep(0.05)
+        if self.proc_liveness() is not None:
+            return results
+        try:
+            resp = self._rpc("pump")
+        except (WireError, OSError):
+            return results
+        self._occupancy = float(resp.get("occupancy", 0.0))
+        for h_str, r in resp.get("results", {}).items():
+            handle = int(h_str)
+            if self._live.pop(handle, None) is None:
+                continue          # settled after a local cancel: drop
+            results[handle] = BackendResult(
+                text=r["text"], completion_tokens=r["completion_tokens"],
+                prompt_tokens=r.get("prompt_tokens"),
+                error=r.get("error"), expired=bool(r.get("expired")))
+        return results
+
+    def busy(self, handle: int) -> bool:
+        return handle in self._live
+
+    def cancel(self, handle: int) -> None:
+        remote = self._live.pop(handle, None)
+        self._failed.pop(handle, None)
+        self._stalled.discard(handle)
+        if not remote or self.proc_liveness() is not None:
+            return
+        try:
+            self._rpc("cancel", handle=handle)
+        except (WireError, OSError):
+            pass          # dying worker: its state is gone anyway
+
+    def count_tokens(self, text: str) -> int:
+        return self._tokenizer.count(text)
+
+    def queue_depth(self) -> int:
+        return len(self._live)
+
+    def occupancy(self) -> float:
+        return self._occupancy if self.kind == "engine" else 0.0
+
+    def proc_stats(self) -> Dict[str, Any]:
+        """Per-process gauges for obs/export.py prometheus_text."""
+        return {"pid": self.pid, "incarnation": self.incarnation,
+                "alive": 0 if self.proc_liveness() is not None else 1,
+                "rpcs": self.rpcs}
+
+    # ------------------------------------------- drain/adopt seam (engine)
+
+    def _snapshot_sequences(self) -> Tuple[Dict[str, Any], List[int]]:
+        resp = self._rpc("snapshot")
+        return resp["snap"], [int(h) for h in resp["handles"]]
+
+    def _adopt_sequences(self, snap: Dict[str, Any],
+                         opts: Sequence[Any]) -> List[int]:
+        from k8s_llm_rca_tpu.serve.journal import encode_gen
+
+        resp = self._rpc("adopt", snap=snap,
+                         gens=[encode_gen(o) for o in opts])
+        handles = [int(h) for h in resp["handles"]]
+        for h in handles:
+            self._live[h] = True
+        return handles
+
+    # ------------------------------------------------------------ lifecycle
+
+    def kill(self) -> None:
+        """Real SIGKILL — the ProcKiller fault path.  No teardown, no
+        cleanup: the point is that the parent finds out the hard way."""
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        self._proc.wait()         # reap immediately; poll() now has rc
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Graceful shutdown: drain frame -> bounded wait -> TERM ->
+        KILL.  Idempotent; never raises over a corpse."""
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        if self._proc.poll() is None and self._dead_evidence is None:
+            try:
+                self._rpc("drain", timeout_s=timeout_s)
+            except (WireError, OSError, WorkerError):
+                pass
+            try:
+                self._proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=timeout_s)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+        self._reap()
+        obs_trace.event("cluster.proc.exit", replica=self.replica_id,
+                        rc=self._proc.poll(),
+                        incarnation=self.incarnation)
+
+    def _reap(self) -> None:
+        try:
+            if self._proc.poll() is None:
+                self._proc.kill()
+            self._proc.wait()
+        except Exception:         # noqa: BLE001 — teardown best-effort
+            pass
+        for stream in (self._proc.stdin, self._proc.stdout):
+            try:
+                if stream is not None:
+                    stream.close()
+            except OSError:
+                pass
+
+
+class ProcReplica(Replica):
+    """A ``Replica`` whose backend lives in its own OS process.
+
+    Presents the exact Replica surface (so ClusterRouter and the
+    watchdog plug in unchanged) plus:
+
+    - ``proc_liveness()``: hard death evidence the router's pump skip
+      and the watchdog's hard-evidence escalation consume;
+    - ``kill_process()``: deliver a real SIGKILL (the ProcKiller path);
+    - ``close()``: the graceful drain -> TERM -> KILL ladder;
+    - a ``rebuild`` recipe that spawns a FRESH process at incarnation+1
+      — ``ReplicaSupervisor.restart`` therefore restarts the actual OS
+      process and rejoins it, with the old corpse reaped first.
+    """
+
+    def __init__(self, replica_id: int, kind: str = "oracle",
+                 spawn_timeout_s: float = DEFAULT_SPAWN_TIMEOUT_S,
+                 rpc_timeout_s: float = DEFAULT_RPC_TIMEOUT_S,
+                 **spec: Any):
+        if os.environ.get(WORKER_ENV):
+            raise ValueError(
+                "nested proc-in-proc: a proc worker must not spawn its "
+                "own proc replicas (one process boundary per replica; "
+                "compose scale with more replicas, not deeper trees)")
+        spec = dict(spec, kind=kind, replica_id=replica_id)
+        spec.setdefault("incarnation", 0)
+        backend = ProcBackend(spec, spawn_timeout_s=spawn_timeout_s,
+                              rpc_timeout_s=rpc_timeout_s)
+
+        def _rebuild() -> ProcBackend:
+            old = self.backend
+            if isinstance(old, ProcBackend):
+                old._reap()       # never leak the corpse's pipes/zombie
+                next_inc = old.incarnation + 1
+            else:
+                next_inc = 1
+            return ProcBackend(dict(spec, incarnation=next_inc),
+                               spawn_timeout_s=spawn_timeout_s,
+                               rpc_timeout_s=rpc_timeout_s)
+
+        super().__init__(replica_id, backend, mesh=None, rebuild=_rebuild)
+
+    def healthy(self) -> bool:
+        return (super().healthy()
+                and self.backend.proc_liveness() is None)
+
+    def proc_liveness(self) -> Optional[str]:
+        return self.backend.proc_liveness()
+
+    def kill_process(self) -> None:
+        self.backend.kill()
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        self.backend.close(timeout_s=timeout_s)
+
+
+def build_proc_replicas(n_replicas: int, kind: str = "oracle",
+                        **spec: Any) -> List[ProcReplica]:
+    """N out-of-process replicas of one kind.
+
+    Loud exclusions (repo convention): proc replicas compose with the
+    router/watchdog/supervisor stack, NOT with multi-device sharding —
+    a worker owns its whole (single-device CPU) engine, so CP/PP/mesh
+    arguments are rejected here instead of failing deep in a worker.
+    """
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    for key in ("mesh", "meshes", "devices_list", "context_parallel",
+                "pipeline_parallel", "cp", "pp", "data", "model"):
+        if key in spec:
+            raise ValueError(
+                f"proc replicas do not compose with {key!r}: each worker "
+                f"owns its whole single-process engine (CP/PP/submesh "
+                f"sharding is the in-process build_replicas path); spawn "
+                f"more replicas instead")
+    return [ProcReplica(rid, kind=kind, **spec)
+            for rid in range(n_replicas)]
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main(sys.argv[1:]))

@@ -67,6 +67,14 @@ class Replica:
         alive (nobody told the router) but never beats again."""
         self.wedged = True
 
+    def healthy(self) -> bool:
+        """Serving right now, as far as the router knows.  Subclasses
+        with a REAL process behind them (cluster/proc.py ProcReplica)
+        also check hard liveness — drain loops that wait for the fleet
+        to settle must use this, not ``alive``/``wedged`` directly, or a
+        SIGKILLed worker would satisfy the predicate while dead."""
+        return self.alive and not self.wedged
+
     def queue_depth(self) -> int:
         b = self.backend
         if hasattr(b, "queue_depth"):

@@ -323,6 +323,13 @@ class ClusterRouter:
                 # wedged: the worker process is gone — nothing to pump,
                 # no beat; the watchdog detects it by the silence
                 continue
+            liveness = getattr(replica, "proc_liveness", None)
+            if liveness is not None and liveness() is not None:
+                # a real dead OS process (cluster/proc.py): pumping its
+                # proxy would "succeed" (empty dict) and beat the
+                # watchdog forever — skip pump AND beat, so the silence
+                # plus the hard exit evidence escalates SUSPECT -> DEAD
+                continue
             # mirror the router's view into the replica engine before its
             # tick, so this tick's TickSample carries this tick's load
             engine = getattr(replica.backend, "engine", None)
@@ -340,9 +347,12 @@ class ClusterRouter:
                 self._deaths.pop(ghandle, None)
                 results[ghandle] = res
             if self.health is not None:
-                self.health.beat(rid, ticks=(engine.heartbeat
-                                             if engine is not None
-                                             else None))
+                # engine-less proc proxies still carry a tick signal:
+                # the worker's protocol heartbeat from its last response
+                ticks = (engine.heartbeat if engine is not None
+                         else getattr(replica.backend, "last_heartbeat",
+                                      None))
+                self.health.beat(rid, ticks=ticks)
         return results
 
     def busy(self, handle: int) -> bool:
@@ -446,26 +456,19 @@ class ClusterRouter:
             raise ValueError(f"drain target {target} must be a DIFFERENT "
                              f"alive replica (alive: {alive})")
         src, dst = replica.backend, self.replicas[target].backend
-        engine = getattr(src, "engine", None)
-        if engine is None or not hasattr(dst, "adopt_sequences"):
+        if (not hasattr(src, "snapshot_sequences")
+                or not hasattr(dst, "adopt_sequences")):
             raise ValueError(
                 "drain_replica needs engine replicas on both sides "
                 "(snapshot_sequences/adopt_sequences); for scripted "
                 "replicas use fail_replica (re-start semantics)")
-        # restore-by-pages seam (docs/cluster.md "warm-start"): publish
-        # the drained engine's resident prefix pages into the shared
-        # PrefixStore BEFORE snapshotting, so the adopter's re-prefill
-        # of each migrated sequence promotes the shared preamble by h2d
-        # page writes (L1 hits) instead of re-burning prefill FLOPs —
-        # PR 3's "mostly-HIT re-prefill" upgraded to page restores.
-        # No-op (returns 0) without a store; the snapshot/adopt contract
-        # is unchanged either way.
-        if hasattr(engine, "flush_prefix_store"):
-            engine.flush_prefix_store()
-        snap = engine.snapshot_sequences()
-        seqs = list(snap.get("sequences", []))
-        # snapshot order -> source local handles, global handles, opts
-        src_lhandles = [src._seq_to_handle[s["seq_id"]] for s in seqs]
+        # the BACKEND-level migration seam (serve/backend.py
+        # EngineBackend.snapshot_sequences): flush-prefix-store (the
+        # warm-start contract), snapshot, and the seq->handle mapping
+        # all happen behind it, so an out-of-process replica
+        # (cluster/proc.py) answers the same call over the wire and the
+        # router never reaches for engine internals it cannot see
+        snap, src_lhandles = src.snapshot_sequences()
         ghandles = [self._local[(rid, lh)] for lh in src_lhandles]
         opts_list = [self._runs[g][1] for g in ghandles]
         new_lhandles = dst.adopt_sequences(snap, opts_list)

@@ -1,0 +1,163 @@
+"""Length-prefixed, CRC-framed JSON message codec for the out-of-process
+replica protocol (cluster/proc.py).
+
+One frame = one protocol message, using EXACTLY the WAL's record framing
+(utils/wal.py: ``[4-byte BE length][4-byte CRC32][payload]``) so the
+corruption semantics are shared with the journal: a torn or bit-flipped
+frame is detected by length/CRC validation, never parsed.  The payload is
+canonical JSON (sorted keys) — the protocol carries only JSON-safe state
+by design (GenOptions ride serve/journal.py's ``encode_gen``; engine
+snapshots are the JSON-safe ``snapshot_sequences`` export).
+
+The crucial difference from the WAL is the FAILURE CONTRACT.  The WAL
+reader stops at the first bad frame and keeps the clean prefix (a torn
+tail is normal after a crash).  A torn or corrupt frame on a LIVE pipe is
+a protocol breach — the peer process is dying, dead, or compromised — so
+the reader raises loudly (``WireCorrupt``) and the caller declares the
+worker dead (ProcBackend marks its transport down; the health watchdog
+turns that evidence into SUSPECT -> DEAD, never a hang).  Blocking reads
+take a timeout (``select`` on the pipe fd): a peer that stops answering
+misses its protocol heartbeat and raises ``WireTimeout`` instead of
+wedging the parent.
+
+``FrameReader`` buffers partial reads across calls, so it works over
+pipes (non-blocking-ish via select + ``read1``) and over in-memory
+streams (io.BytesIO) for the codec unit tests.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import select
+import struct
+import zlib
+from typing import Any, Dict, Optional
+
+from k8s_llm_rca_tpu.utils import wal
+
+HEADER = struct.Struct(">II")           # (length, crc32) — wal._HEADER twin
+HEADER_SIZE = wal.HEADER_SIZE
+MAX_FRAME_SIZE = wal.MAX_RECORD_SIZE
+_CHUNK = 65536
+
+
+class WireError(RuntimeError):
+    """Base class: the frame stream to a worker is unusable."""
+
+
+class WireEOF(WireError):
+    """Clean EOF at a frame boundary — the peer closed its end (a worker
+    that drained and exited, or a parent that went away)."""
+
+
+class WireCorrupt(WireError):
+    """Torn frame (EOF mid-frame), CRC mismatch, oversized length, or
+    unparseable payload — protocol breach; declare the peer dead."""
+
+
+class WireTimeout(WireError):
+    """No complete frame within the deadline — the peer missed its
+    protocol heartbeat; declare it dead rather than hang."""
+
+
+def pack_frame(msg: Dict[str, Any]) -> bytes:
+    """One message -> wire bytes (WAL framing over canonical JSON)."""
+    payload = json.dumps(msg, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return wal.pack_record(payload)
+
+
+def write_frame(stream, msg: Dict[str, Any]) -> None:
+    """Write one frame and flush (a frame is an RPC turn — it must not
+    sit in a userspace buffer while the peer blocks on it).  Raises the
+    stream's own error (BrokenPipeError and friends) when the peer is
+    gone; the caller owns declaring the transport dead."""
+    stream.write(pack_frame(msg))
+    stream.flush()
+
+
+class FrameReader:
+    """Incremental frame decoder over a readable binary stream.
+
+    ``read_frame(timeout_s)`` returns the next decoded message dict, or
+    raises ``WireEOF`` / ``WireCorrupt`` / ``WireTimeout`` per the module
+    contract.  Partial bytes are buffered across calls.  ``timeout_s``
+    needs a real file descriptor (select); in-memory streams are always
+    "ready" and simply read to exhaustion.
+    """
+
+    def __init__(self, stream):
+        self._stream = stream
+        self._buf = bytearray()
+        try:
+            self._fd: Optional[int] = stream.fileno()
+        except (AttributeError, OSError, io.UnsupportedOperation):
+            self._fd = None
+
+    def _try_decode(self) -> Optional[Dict[str, Any]]:
+        buf = self._buf
+        if len(buf) < HEADER_SIZE:
+            return None
+        length, crc = HEADER.unpack(bytes(buf[:HEADER_SIZE]))
+        if length > MAX_FRAME_SIZE:
+            raise WireCorrupt(
+                f"frame length {length} exceeds MAX_FRAME_SIZE "
+                f"{MAX_FRAME_SIZE} (corrupt header)")
+        if len(buf) < HEADER_SIZE + length:
+            return None
+        payload = bytes(buf[HEADER_SIZE:HEADER_SIZE + length])
+        if zlib.crc32(payload) != crc:
+            raise WireCorrupt(
+                f"frame CRC mismatch (length {length}): the pipe carried "
+                f"corrupted bytes")
+        del buf[:HEADER_SIZE + length]
+        try:
+            msg = json.loads(payload.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise WireCorrupt(
+                f"frame payload passed CRC but is not JSON: {e}") from e
+        if not isinstance(msg, dict):
+            raise WireCorrupt(
+                f"frame payload must be a JSON object, got "
+                f"{type(msg).__name__}")
+        return msg
+
+    def _fill(self, timeout_s: Optional[float]) -> None:
+        """Read at least one more byte into the buffer, honoring the
+        timeout when the stream has a pollable fd."""
+        if self._fd is not None and timeout_s is not None:
+            ready, _, _ = select.select([self._fd], [], [], timeout_s)
+            if not ready:
+                raise WireTimeout(
+                    f"no frame within {timeout_s}s: peer missed its "
+                    f"protocol heartbeat")
+        read1 = getattr(self._stream, "read1", None)
+        chunk = read1(_CHUNK) if read1 is not None \
+            else self._stream.read(_CHUNK)
+        if not chunk:
+            if self._buf:
+                raise WireCorrupt(
+                    f"torn frame: EOF with {len(self._buf)} buffered "
+                    f"byte(s) mid-frame")
+            raise WireEOF("peer closed the stream at a frame boundary")
+        self._buf.extend(chunk)
+
+    def read_frame(self, timeout_s: Optional[float] = None
+                   ) -> Dict[str, Any]:
+        import time as _time
+
+        deadline = (None if timeout_s is None
+                    else _time.monotonic() + timeout_s)
+        while True:
+            msg = self._try_decode()
+            if msg is not None:
+                return msg
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise WireTimeout(
+                        f"no frame within {timeout_s}s: peer missed its "
+                        f"protocol heartbeat")
+            self._fill(remaining)

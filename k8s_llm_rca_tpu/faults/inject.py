@@ -32,7 +32,12 @@ Sites in the real stack:
   replica "crash" — one replica dies and the router fails its in-flight
   runs over onto survivors (cluster/router.py).  Same discipline as
   SITE_PROCESS: polled from the killer's OWN plan at incident
-  boundaries, never from the armed chaos plan.
+  boundaries, never from the armed chaos plan;
+- ``SITE_PROC`` (``faults/supervisor.py::ProcKiller``): REAL process
+  kill — a scheduled "crash" delivers SIGKILL to an out-of-process
+  replica's worker (cluster/proc.py), and the health watchdog must
+  detect the actual OS death (pipe EOF / exit code) and heal.  Same
+  own-plan, incident-boundary discipline as SITE_REPLICA.
 """
 
 from __future__ import annotations
@@ -47,6 +52,7 @@ SITE_BACKEND = "backend.start"
 SITE_ENGINE_TICK = "engine.tick"
 SITE_PROCESS = "serve.process"
 SITE_REPLICA = "cluster.replica"
+SITE_PROC = "cluster.proc"
 
 # the armed plan; hot paths read this directly (see module docstring)
 _ARMED: Optional[FaultPlan] = None

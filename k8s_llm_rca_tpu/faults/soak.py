@@ -109,11 +109,22 @@ def _build_oracle_service(run_timeout_s: float, clock, journal=None):
 
 def _build_cluster_service(run_timeout_s: float, clock, journal=None,
                            n_replicas: int = 2, oracle: bool = False,
-                           selfheal: bool = False, health_policy=None):
+                           selfheal: bool = False, health_policy=None,
+                           proc: bool = False):
     """N-replica serving behind a ClusterRouter (cluster/).  ``oracle``
     replicas are scripted backends — the cheap mode the 100-incident
     replica-kill soak runs on (tier-1 budget); engine replicas reuse the
     single-engine soak's TINY config, sharded onto disjoint submeshes.
+
+    ``proc``: out-of-process replicas (cluster/proc.py) — each replica's
+    scripted-oracle backend runs in its OWN interpreter behind the wire
+    protocol, so a killer can deliver REAL SIGKILLs and the watchdog
+    detects actual process death.  The workers poll no fault sites
+    (exactly like the in-process OracleBackend) and the serving
+    semantics are transport-invariant, which is why the proc soak's
+    report is byte-identical to the in-process cluster-oracle run (the
+    report even says ``cluster-oracle`` — transport is a deployment
+    detail, not an outcome).
 
     ``selfheal``: arm the self-healing loop (cluster/health.py) — a
     HealthWatchdog on the soak's VirtualClock plus a restart-enabled
@@ -128,7 +139,12 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
     from k8s_llm_rca_tpu.cluster import ClusterRouter, Replica
     from k8s_llm_rca_tpu.serve.api import AssistantService
 
-    if oracle:
+    if proc:
+        from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
+
+        replicas = build_proc_replicas(n_replicas, kind="oracle")
+        engines = []
+    elif oracle:
         from k8s_llm_rca_tpu.rca.oracle import OracleBackend
         from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
 
@@ -167,6 +183,23 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
     return (AssistantService(router, run_timeout_s=run_timeout_s,
                              clock=clock, journal=journal),
             engines, factory, router)
+
+
+@contextlib.contextmanager
+def _reaping_workers(router):
+    """Close any out-of-process replica workers when the block exits —
+    even on a sweep failure, a soak must never leak worker processes.
+    ``ProcReplica.close`` runs the drain -> TERM -> KILL ladder and
+    touches no replica flags, so the caller's post-soak fleet
+    assertions (alive/restart counts) see the healed state."""
+    try:
+        yield
+    finally:
+        if router is not None:
+            for r in router.replicas.values():
+                close = getattr(r, "close", None)
+                if close is not None:
+                    close()
 
 
 def _incident_row(message: str, result: Dict[str, Any]) -> Dict[str, Any]:
@@ -314,11 +347,12 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
         service, engine, factory = _build_engine_service(
             run_timeout_s, clock, journal)
         engines = [engine]
-    elif backend in ("cluster", "cluster-oracle"):
+    elif backend in ("cluster", "cluster-oracle", "proc-cluster"):
         service, engines, factory, router = _build_cluster_service(
             run_timeout_s, clock, journal,
             n_replicas=cluster_replicas,
             oracle=(backend == "cluster-oracle"),
+            proc=(backend == "proc-cluster"),
             selfheal=selfheal)
         engine = None   # "engine_clean" is per-replica below
     elif selfheal:
@@ -376,7 +410,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
 
     incidents: List[Dict[str, Any]] = []
     n_resolved = n_degraded = n_failed = 0
-    with inject.armed(plan), obs_ctx:
+    with inject.armed(plan), obs_ctx, _reaping_workers(
+            router if backend == "proc-cluster" else None):
         if concurrency > 1:
             from k8s_llm_rca_tpu.rca.scheduler import (
                 IncidentFailure, SweepScheduler,
@@ -449,8 +484,11 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
             # hung_tick_threshold probes plus the healing pump.
             budget = router.health.policy.hung_tick_threshold + 2
             for _ in range(budget):
-                if all(r.alive and not r.wedged
-                       for r in router.replicas.values()):
+                # healthy(), not alive-and-not-wedged: a SIGKILLed proc
+                # replica is alive-looking until the watchdog's verdict
+                # (cluster/replica.py) — the old predicate would break
+                # out with a corpse still in the fleet
+                if all(r.healthy() for r in router.replicas.values()):
                     break
                 router.pump()
 
@@ -463,7 +501,12 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
 
     report = {
         "seed": seed,
-        "backend": backend,
+        # proc-cluster reports as cluster-oracle ON PURPOSE: the workers
+        # run the same scripted oracle over a different transport, and
+        # the acceptance bar is byte-identity against the in-process
+        # run — a transport tag would be the one engineered difference
+        "backend": ("cluster-oracle" if backend == "proc-cluster"
+                    else backend),
         "n_incidents": n_incidents,
         "completed": n_resolved + n_degraded,
         "resolved": n_resolved,
@@ -591,6 +634,12 @@ def run_pipelined_sweep(seed: int = 0, n_incidents: int = 10,
         service, _engine, _factory = _build_oracle_service(
             run_timeout_s, clock, journal)
         engines = []
+    elif backend == "proc-cluster":
+        raise ValueError(
+            "backend='proc-cluster' is chaos-soak-only (run_chaos_soak): "
+            "the pipelined sweep returns live run handles that would "
+            "outlive the worker processes — use backend='cluster-oracle' "
+            "here, or run_chaos_soak for the out-of-process fleet")
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -972,7 +1021,7 @@ def run_open_loop_soak(seed: int = 0, rate_per_s: float = 200.0,
     if router.health is not None:
         budget = router.health.policy.hung_tick_threshold + 2
         for _ in range(budget):      # heal a storm-tail wedge (see
-            if all(r.alive and not r.wedged   # run_chaos_soak drain)
+            if all(r.healthy()       # run_chaos_soak drain)
                    for r in router.replicas.values()):
                 break
             router.pump()

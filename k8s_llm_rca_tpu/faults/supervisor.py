@@ -146,61 +146,133 @@ class ReplicaKiller:
     in-flight runs re-start from their recorded prompts on survivors
     (greedy decode makes the final outputs identical).  The victim is
     chosen deterministically from the alive list by the fault's poll
-    index.  HOW it kills depends on the router:
+    index.  HOW it kills is the explicit per-plan ``mode``:
 
-    - plain router: ``router.fail_replica`` directly (PR 6 semantics —
-      the kill and the failover are one external call);
-    - self-healing router (``attach_health`` armed): the victim is
-      *wedged* (``Replica.wedge`` — the process dies, nobody tells the
-      router) and the watchdog must detect the silence, fail over and,
-      with a restart-enabled ``ReplicaSupervisor``, rejoin a fresh
-      incarnation — the kill-and-heal soak proves all of that happens
-      with NO external ``fail_replica`` call.
+    - ``"auto"`` (default, the historical behavior): *wedge* the victim
+      when a self-healing router (``attach_health``) is armed — the
+      process "dies" silently and the watchdog owns detection, failover
+      and restart — else ``router.fail_replica`` directly (PR 6
+      semantics: the kill and the failover are one external call).
+      Auto REFUSES out-of-process victims loudly: a ProcReplica's
+      worker is a real OS process, and silently wedging its proxy would
+      test nothing the fleet claims to survive — say ``mode="sigkill"``
+      (or use ``ProcKiller``) to mean it, or ``mode="wedge"`` to
+      simulate on purpose.
+    - ``"wedge"``: always simulate (requires an attached watchdog —
+      without one, nobody would ever detect the wedge).
+    - ``"sigkill"``: deliver a REAL SIGKILL through the victim's
+      ``kill_process()`` (cluster/proc.py).  With a watchdog the
+      detection path is the hard-evidence escalation (pipe EOF / exit
+      code); without one the killer SIGKILLs and then calls
+      ``fail_replica`` itself, since no machinery would ever notice.
 
     The last alive replica is killed only when a restart-enabled
-    supervisor is attached (the fleet provably recovers); otherwise the
-    kill is skipped, preserving the original refusal — a cluster soak
-    without restart is a failover proof, not an outage proof.
+    supervisor is attached (the fleet provably recovers); otherwise a
+    wedge-mode kill is skipped with a warning (the historical
+    contract), while ``"sigkill"`` raises ValueError — really killing
+    the last real process with no restart path is an outage by
+    construction, and asking for it is a plan bug, not a scenario.
 
     ``router`` may be bound after construction (``killer.router = r``) —
     ``run_chaos_soak`` builds the router itself and binds the killer to
     it before the sweep starts.
     """
 
-    def __init__(self, plan: FaultPlan, router=None):
+    KILL_MODES = ("auto", "wedge", "sigkill")
+    site = inject.SITE_REPLICA
+
+    def __init__(self, plan: FaultPlan, router=None, mode: str = "auto"):
+        if mode not in self.KILL_MODES:
+            raise ValueError(f"unknown kill mode {mode!r}: expected one "
+                             f"of {self.KILL_MODES}")
         self.plan = plan
         self.router = router
+        self.mode = mode
         self.kills: List[int] = []
+
+    def _kill(self, victim: int) -> None:
+        """Deliver the kill per ``self.mode`` (victim already chosen,
+        last-alive policy already applied in ``checkpoint``)."""
+        replica = self.router.replicas[victim]
+        is_proc = hasattr(replica, "kill_process")
+        health = getattr(self.router, "health", None)
+        mode = self.mode
+        if mode == "auto":
+            if is_proc:
+                raise ValueError(
+                    f"ReplicaKiller(mode='auto') refuses out-of-process "
+                    f"replica {victim}: wedging a ProcReplica's proxy "
+                    f"would only simulate a death the fleet could take "
+                    f"for real — say mode='sigkill' (or ProcKiller) for "
+                    f"a real SIGKILL, or mode='wedge' to simulate on "
+                    f"purpose")
+            mode = "wedge" if health is not None else "fail"
+        if mode == "wedge":
+            if health is None:
+                raise ValueError(
+                    f"ReplicaKiller(mode='wedge') without an attached "
+                    f"HealthWatchdog: nothing would ever detect the "
+                    f"wedge on replica {victim} (attach_health, or use "
+                    f"mode='auto' for direct fail_replica)")
+            replica.wedge()
+        elif mode == "sigkill":
+            if not is_proc:
+                raise ValueError(
+                    f"ReplicaKiller(mode='sigkill') needs an out-of-"
+                    f"process victim with kill_process() (cluster/"
+                    f"proc.py ProcReplica); replica {victim} is "
+                    f"in-process — use mode='wedge'/'auto'")
+            replica.kill_process()
+            if health is None:
+                # no watchdog: nobody would ever observe the corpse —
+                # the killer completes the PR 6 two-in-one semantics
+                self.router.fail_replica(victim)
+        else:
+            self.router.fail_replica(victim)
 
     def checkpoint(self) -> Optional[int]:
         """Incident-boundary poll: kills one replica on a scheduled
         "crash"; returns the victim's replica id, else None."""
-        fault = self.plan.poll(inject.SITE_REPLICA)
+        fault = self.plan.poll(self.site)
         if fault is None or self.router is None:
             return None
         if fault.kind != "crash":
             log.warning("replica fault %r ignored: only 'crash' is "
-                        "meaningful at %s", fault.kind,
-                        inject.SITE_REPLICA)
+                        "meaningful at %s", fault.kind, self.site)
             return None
         alive = self.router.alive_ids()
         sup = getattr(self.router, "supervisor", None)
         restart_on = sup is not None and getattr(sup, "restart_enabled",
                                                  False)
         if len(alive) <= 1 and not restart_on:
+            if self.mode == "sigkill":
+                raise ValueError(
+                    f"refusing SIGKILL: {len(alive)} replica(s) alive "
+                    f"and no restart-enabled supervisor — killing the "
+                    f"last real process is an unrecoverable outage, "
+                    f"not a chaos scenario (attach a restart-enabled "
+                    f"ReplicaSupervisor)")
             log.warning("replica kill skipped: %d replica(s) alive and "
                         "no restart-enabled supervisor", len(alive))
             return None
         victim = alive[fault.index % len(alive)]
-        if getattr(self.router, "health", None) is not None:
-            # self-healing cluster: the kill is a wedge — the process
-            # dies silently and the watchdog owns detection, failover
-            # and restart (no external fail_replica call)
-            self.router.replicas[victim].wedge()
-        else:
-            self.router.fail_replica(victim)
+        self._kill(victim)
         self.kills.append(victim)
         METRICS.inc("faults.replica_kills")
         log.warning("replica kill #%d: replica %d killed (%d alive)",
                     len(self.kills), victim, len(self.router.alive_ids()))
         return victim
+
+
+class ProcKiller(ReplicaKiller):
+    """ReplicaKiller specialized for out-of-process fleets: polls
+    ``inject.SITE_PROC`` on its own plan and always delivers a REAL
+    SIGKILL (``mode="sigkill"``), so the 100-incident kill-and-heal soak
+    exercises actual OS process death — pipe EOF / exit-code detection,
+    real restart-and-rejoin — under the exact boundary-poll discipline
+    the byte-identity proof requires."""
+
+    site = inject.SITE_PROC
+
+    def __init__(self, plan: FaultPlan, router=None):
+        super().__init__(plan, router, mode="sigkill")

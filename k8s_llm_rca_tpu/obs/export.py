@@ -324,6 +324,30 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
                        "replica")
         for rid in sorted(occs):
             fam_o.add(occs[rid], labels=f'{{replica="{rid}"}}')
+        # out-of-process replicas (cluster/proc.py): one row per worker
+        # process — pid / incarnation as labels so a restart is visible
+        # as a label change, aliveness and RPC volume as the values
+        fam_alive = None
+        fam_rpc = None
+        for rid in sorted(router.replicas):
+            stats_fn = getattr(router.replicas[rid].backend, "proc_stats",
+                               None)
+            if stats_fn is None:
+                continue
+            stats = stats_fn()
+            if fam_alive is None:
+                fam_alive = family(
+                    f"{_PREFIX}cluster_proc_alive", "gauge",
+                    "worker process liveness per out-of-process replica "
+                    "(1=running 0=exited)")
+                fam_rpc = family(
+                    f"{_PREFIX}cluster_proc_rpcs", "gauge",
+                    "wire RPCs completed against each worker process "
+                    "incarnation")
+            labels = (f'{{replica="{rid}",pid="{stats["pid"]}",'
+                      f'incarnation="{stats["incarnation"]}"}}')
+            fam_alive.add(stats["alive"], labels=labels)
+            fam_rpc.add(stats["rpcs"], labels=labels)
         health = getattr(router, "health", None)
         if health is not None:
             # watchdog verdict per replica, numerically encoded so the

@@ -91,6 +91,12 @@ SITES = frozenset({
     "cluster.quarantine",
     "cluster.mttd",
     "cluster.mttr",
+    # out-of-process replicas (cluster/proc.py): worker spawn (ready
+    # handshake included), every parent->worker RPC over the framed
+    # pipe, and the worker's exit (clean close or reaped corpse)
+    "cluster.proc.spawn",
+    "cluster.proc.rpc",
+    "cluster.proc.exit",
     # graph layer
     "graph.query",
     # rca pipeline stages

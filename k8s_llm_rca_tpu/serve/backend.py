@@ -355,6 +355,24 @@ class EngineBackend:
             handles.append(handle)
         return handles
 
+    def snapshot_sequences(self) -> Tuple[Dict[str, object], List[int]]:
+        """Snapshot every live engine sequence for migration, returning
+        ``(snapshot, handles)`` — the JSON-safe engine export plus THIS
+        backend's handle for each snapshotted sequence, in snapshot
+        order.  The backend-level seam ``ClusterRouter.drain_replica``
+        works through (proc replicas answer it over the wire — the
+        router must not reach for ``engine._seq_to_handle`` internals
+        that live in another process).  Resident prefix pages are
+        published to the shared PrefixStore FIRST, so the adopter's
+        re-prefill promotes them by h2d page writes (the warm-start
+        contract, docs/cluster.md)."""
+        if hasattr(self.engine, "flush_prefix_store"):
+            self.engine.flush_prefix_store()
+        snap = self.engine.snapshot_sequences()
+        handles = [self._seq_to_handle[s["seq_id"]]
+                   for s in snap.get("sequences", [])]
+        return snap, handles
+
     def host_counters(self) -> Dict[str, float]:
         """Cumulative host<->device traffic counters of the backing
         engine (engine.h2d_uploads / d2h_syncs / dispatches /

@@ -601,6 +601,28 @@ class TestSiteCoverage:
         assert "engine.idle_ticks" in tr_idle.emitted_names()
         assert (engine._counts or {}).get("engine.idle_ticks", 0) > 0
 
+        # (9) out-of-process sites: spawn ONE real oracle worker (own
+        # interpreter, ~0.5 s), run a start/pump round-trip over the
+        # framed pipe, and close it — spawn span, rpc spans and the exit
+        # event all fire (cluster/proc.py)
+        from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
+
+        tr_proc = Tracer(clock=VirtualClock())
+        tracers.append(tr_proc)
+        with obs_trace.tracing(tr_proc):
+            (proc_replica,) = build_proc_replicas(1, kind="oracle")
+            try:
+                hp = proc_replica.backend.start("node notready",
+                                                GenOptions())
+                for _ in range(20):
+                    if hp in proc_replica.backend.pump():
+                        break
+                assert not proc_replica.backend.busy(hp)
+            finally:
+                proc_replica.close()
+        assert {"cluster.proc.spawn", "cluster.proc.rpc",
+                "cluster.proc.exit"} <= tr_proc.emitted_names()
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:
