@@ -1030,6 +1030,77 @@ def bench_proc_cluster(n_pings: int = 30, n_runs: int = 8):
             "killed_restarts": restarts}
 
 
+def bench_net_cluster(n_pings: int = 30, n_runs: int = 8):
+    """Cross-host replica leg (cluster/net.py): socket-transport echo
+    workers on loopback, one fresh interpreter, measurement-or-null.
+
+    Same trust argument as ``bench_proc_cluster``: CPU echo workers
+    never touch the tunnel, so loopback-socket wall-clock is LOCAL cost
+    the memoizer and the ~0.25 s dispatch latency cannot touch.
+
+    - ``rpc_roundtrip_p50_ms``: p50 of ``n_pings`` framed ping
+      round-trips over the fenced socket link (distinct payloads).
+    - ``relink_recovery_s``: wall-clock from a REAL mid-flight link
+      partition (``partition_link()`` severs the loopback socket) to
+      every in-flight run settled AND the link healed by relink — same
+      worker incarnation, fresh session nonce, ZERO process restarts.
+    - ``partitions_healed``: exact count of supervisor-journaled
+      relinks during the partition scenario (count-exact).
+    """
+    import time
+
+    from k8s_llm_rca_tpu.cluster import (
+        ClusterRouter, HealthPolicy, HealthWatchdog, ReplicaSupervisor,
+    )
+    from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
+    from k8s_llm_rca_tpu.serve.backend import GenOptions
+
+    replicas = build_proc_replicas(2, kind="echo", echo_delay_pumps=2,
+                                   transport="socket")
+    try:
+        lat = []
+        for i in range(n_pings):
+            t0 = time.perf_counter()
+            replicas[0].backend._rpc("ping", probe=i)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        rpc_p50_ms = round(lat[len(lat) // 2] * 1000.0, 4) if lat else None
+
+        router = ClusterRouter(replicas)
+        wd = HealthWatchdog(HealthPolicy(miss_budget=1,
+                                         hung_tick_threshold=2))
+        sup = ReplicaSupervisor()
+        router.attach_health(wd, sup)
+        handles = [router.start(f"bench run {i}", GenOptions())
+                   for i in range(n_runs)]
+        victim = max(router.alive_ids(),
+                     key=lambda r: (router.replicas[r].queue_depth(), r))
+        t0 = time.perf_counter()
+        router.replicas[victim].partition_link()
+        out = {}
+        for _ in range(256):
+            out.update(router.pump())
+            stats = router.replicas[victim].backend.link_stats()
+            if (all(h in out for h in handles)
+                    and stats is not None and stats["alive"]):
+                break
+        stats = router.replicas[victim].backend.link_stats()
+        healed = (all(h in out for h in handles)
+                  and all(v.error is None for v in out.values())
+                  and stats is not None and stats["alive"]
+                  and not sup.restarts          # relink, NOT respawn
+                  and len(router.alive_ids()) == 2)
+        recovery_s = (round(time.perf_counter() - t0, 4)
+                      if healed else None)
+        relinks = len(sup.relinks) if healed else None
+    finally:
+        for r in replicas:
+            r.close()
+    return {"rpc_roundtrip_p50_ms": rpc_p50_ms,
+            "relink_recovery_s": recovery_s,
+            "partitions_healed": relinks}
+
+
 def bench_host_overlap(n_prompts: int = 48, max_batch: int = 8,
                        prompt_len: int = 64, max_new: int = 32):
     """Overlapped-hot-loop leg (docs/performance.md): the TINY paged
@@ -1314,6 +1385,7 @@ def main():
     selfheal = _leg("bench.bench_selfheal()", timeout=1500) or {}
     prefix_tiers = _leg("bench.bench_prefix_leg()", timeout=1500) or {}
     proc_cluster = _leg("bench.bench_proc_cluster()", timeout=1500) or {}
+    net_cluster = _leg("bench.bench_net_cluster()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -1515,6 +1587,15 @@ def main():
         "proc_failover_recovery_s": proc_cluster.get(
             "failover_recovery_s"),
         "proc_killed_restarts": proc_cluster.get("killed_restarts"),
+        # cross-host replicas (cluster/net.py): socket echo workers on
+        # loopback — framed-RPC round-trip p50, partition-to-relinked
+        # recovery (same incarnation, zero restarts), and the exact
+        # journaled relink count; null when the leg failed — schema
+        # stays stable
+        "net_rpc_roundtrip_p50_ms": net_cluster.get(
+            "rpc_roundtrip_p50_ms"),
+        "net_relink_recovery_s": net_cluster.get("relink_recovery_s"),
+        "net_partitions_healed": net_cluster.get("partitions_healed"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

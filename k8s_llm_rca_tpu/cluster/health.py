@@ -131,6 +131,10 @@ class HealthWatchdog:
         self._last_eval: Optional[float] = None
         self.detections: List[int] = []         # rid per DEAD verdict
         self.hard_detections: List[int] = []    # subset with OS evidence
+        # evidence kind per hard detection, parallel to hard_detections:
+        # "proc" (process death) vs "link" (relink budget exhausted) —
+        # a separate list so hard_detections stays a plain rid list
+        self.hard_kinds: List[str] = []
         self.mttd_s: List[float] = []           # last beat -> verdict
 
     def _now(self) -> float:
@@ -177,17 +181,19 @@ class HealthWatchdog:
         return self._detected_t.get(rid)
 
     def _declare_dead(self, rid: int, now: float, misses: int,
-                      evidence: Optional[str] = None) -> None:
+                      evidence: Optional[str] = None,
+                      kind: str = "proc") -> None:
         self._states[rid] = DEAD
         self._detected_t[rid] = now
         self.detections.append(rid)
         if evidence is not None:
             self.hard_detections.append(rid)
+            self.hard_kinds.append(kind)
         t0 = self._beat_t.get(rid, now)
         self.mttd_s.append(max(0.0, now - t0))
         METRICS.inc("cluster.deaths_detected")
         obs_trace.event("cluster.health", replica=rid, state=DEAD,
-                        misses=misses, evidence=evidence)
+                        misses=misses, evidence=evidence, kind=kind)
         tr = obs_trace._ACTIVE
         if tr is not None:
             tr.add_span("cluster.mttd", t0, now, cat="cluster",
@@ -227,10 +233,14 @@ class HealthWatchdog:
             liveness = getattr(replica, "proc_liveness", None)
             evidence = liveness() if liveness is not None else None
             if evidence is not None:
+                # "link" when the verdict came from relink-budget
+                # exhaustion (cluster/proc.py death_kind), "proc" else
+                ekind = getattr(replica, "evidence_kind", None)
+                kind = ekind() if ekind is not None else "proc"
                 self._miss[rid] = self._miss.get(rid, 0) + 1
                 if self._states.get(rid) == SUSPECT:
                     self._declare_dead(rid, now, self._miss[rid],
-                                       evidence=evidence)
+                                       evidence=evidence, kind=kind)
                     newly_dead.append(rid)
                 else:
                     self._states[rid] = SUSPECT
@@ -294,6 +304,11 @@ class ReplicaSupervisor:
         self.warmup_prompt = warmup_prompt
         self.router = None
         self.restarts: List[int] = []           # rid per restart, in order
+        # rid per successful RELINK (same incarnation, new nonce) — the
+        # router's _replay_relinked records these; a soak asserting
+        # "every heal was a relink" checks relinks against the killer's
+        # kills and restarts == []
+        self.relinks: List[int] = []
         self.incarnations: Dict[int, int] = {}  # rid -> rebuild count
         self.restart_s: List[float] = []        # wall rebuild(+warmup) cost
         self.mttr_s: List[float] = []           # verdict -> rejoined

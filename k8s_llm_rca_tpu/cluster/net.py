@@ -1,0 +1,277 @@
+"""Transport seam for the out-of-process replica protocol: the SAME
+framed codec (cluster/wire.py) over a stdio pipe pair or a TCP socket.
+
+PR 12's ``ProcBackend`` hardcoded its Popen stdin/stdout pair; this
+module extracts that into a two-method ``Transport`` (``send``/``recv``)
+so the parent<->worker protocol is deployment-agnostic:
+
+- ``PipeTransport``: the existing behavior, byte-identical — blocking
+  ``write_frame`` into the worker's stdin, select-deadlined
+  ``FrameReader`` off its stdout.  A pipe to a child process cannot
+  partition: every failure IS process death, so pipe transports are not
+  relinkable and the PR 12 evidence semantics are unchanged.
+- ``SocketTransport``: the cross-host shape (locally provable over
+  ``socket.socketpair``/loopback).  Reads ride the same ``FrameReader``
+  (an unbuffered ``makefile`` keeps the fd select-accurate); writes gain
+  the bounded select-based deadline pipes never needed — a zero-window
+  or trickle-reading peer raises ``WireTimeout`` instead of wedging the
+  parent in a blocking ``flush()``.  A socket CAN die while the worker
+  lives (partition, half-open link, peer reset), so socket transports
+  are ``relinkable``: the owner may replace a failed link with a fresh
+  connection to the same incarnation (cluster/proc.py's relink path).
+
+Link fencing (the ``hello``/``ready`` handshake, cluster/proc.py): every
+connection to a socket worker opens with a parent->worker ``hello``
+carrying a monotonic per-connection **session nonce**; the worker adopts
+the connection only for a nonce STRICTLY greater than the one it is
+serving (dropping the old link — at most one live link per worker, no
+split-brain), refuses stale nonces on the new connection, and tags every
+reply with the adopted nonce so the parent can discard frames from a
+link it already abandoned.  ``client_handshake`` implements the parent
+half; the worker half lives in ``cluster/proc.py``'s ``--listen`` serve
+loop.
+"""
+
+from __future__ import annotations
+
+import select
+import socket
+import time
+from typing import Any, Dict, Optional
+
+from k8s_llm_rca_tpu.cluster.wire import (
+    FrameReader, WireEOF, WireTimeout, pack_frame, write_frame,
+)
+
+# a frame is one RPC turn on an idle-ish loopback/LAN link: if the peer
+# cannot accept 16 MiB in this window its receive path is wedged, which
+# is link evidence, not patience territory
+DEFAULT_WRITE_TIMEOUT_S = 30.0
+
+# the hello->ready turn of a freshly-accepted connection: the worker is
+# already up (it answered the bootstrap frame), so only link latency and
+# its select loop are in the window
+DEFAULT_HANDSHAKE_TIMEOUT_S = 10.0
+
+
+def send_with_deadline(sock: socket.socket, data: bytes,
+                       timeout_s: float) -> None:
+    """Write ``data`` to a connected socket under one overall deadline.
+
+    ``select``-gates every ``send`` so a peer advertising a zero TCP
+    window (or reading a byte an hour) raises ``WireTimeout`` instead of
+    blocking forever; a reset/closed peer raises its ``OSError``
+    (BrokenPipeError/ConnectionResetError) for the caller to classify.
+
+    The socket is switched non-blocking for the duration of the loop
+    (and restored after): a BLOCKING ``send`` of a large frame queues
+    the WHOLE remainder in the kernel and sleeps when the peer's window
+    fills — the select gate only proves the first byte won't block.
+    Non-blocking sends return the partial count (or EAGAIN, folded back
+    into the select wait), so the deadline actually binds.
+    """
+    if timeout_s <= 0:
+        raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+    deadline = time.monotonic() + timeout_s
+    view = memoryview(data)
+    sent = 0
+    prior_timeout = sock.gettimeout()
+    sock.setblocking(False)
+    try:
+        while sent < len(view):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise WireTimeout(
+                    f"peer accepted {sent}/{len(view)} frame byte(s) "
+                    f"within {timeout_s}s: send window wedged "
+                    f"(zero-window or trickle-reading peer)")
+            _, writable, _ = select.select([], [sock], [], remaining)
+            if not writable:
+                raise WireTimeout(
+                    f"peer accepted {sent}/{len(view)} frame byte(s) "
+                    f"within {timeout_s}s: send window wedged "
+                    f"(socket never became writable again)")
+            try:
+                sent += sock.send(view[sent:])
+            except (BlockingIOError, InterruptedError):
+                continue      # spurious wakeup: re-select
+    finally:
+        sock.settimeout(prior_timeout)
+
+
+def send_frame_socket(sock: socket.socket, msg: Dict[str, Any],
+                      timeout_s: float = DEFAULT_WRITE_TIMEOUT_S) -> None:
+    """One message onto a socket under the bounded write deadline."""
+    send_with_deadline(sock, pack_frame(msg), timeout_s)
+
+
+class PipeTransport:
+    """The PR 12 stdio pair behind the Transport surface — byte-identical
+    behavior: blocking frame write + flush into ``wstream``, deadlined
+    frame reads off ``rstream``.  Not relinkable: a broken pipe to a
+    child means the child (or its stdio) is gone, which is process-death
+    evidence by definition."""
+
+    kind = "pipe"
+    relinkable = False
+
+    def __init__(self, wstream, rstream):
+        self._wstream = wstream
+        self._reader = FrameReader(rstream)
+        self._rstream = rstream
+
+    def send(self, msg: Dict[str, Any],
+             timeout_s: Optional[float] = None) -> None:
+        # a pipe write blocks only while the child is alive-and-reading;
+        # the deadline parameter exists for surface parity with sockets
+        write_frame(self._wstream, msg)
+
+    def recv(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        return self._reader.read_frame(timeout_s=timeout_s)
+
+    def pending(self) -> Optional[Dict[str, Any]]:
+        return self._reader.pending()
+
+    def send_raw(self, data: bytes,
+                 timeout_s: Optional[float] = None) -> None:
+        """Raw bytes onto the wire (fault-injection seam: netem trickle
+        sends a packed frame one byte per call; chaos corruption sends
+        bytes that are not a frame at all)."""
+        self._wstream.write(data)
+        self._wstream.flush()
+
+    def close(self) -> None:
+        for stream in (self._wstream, self._rstream):
+            try:
+                if stream is not None:
+                    stream.close()
+            except OSError:
+                pass
+
+
+class SocketTransport:
+    """One connected socket behind the Transport surface.
+
+    Reads: ``FrameReader`` over an unbuffered ``makefile("rb")`` — no
+    userspace buffering between the fd and the reader, so the reader's
+    select deadline sees exactly what the kernel holds.  Writes:
+    ``send_with_deadline`` — the bounded select-gated write that turns a
+    wedged peer into ``WireTimeout``.  ``nonce`` is the session nonce
+    this link was fenced with at handshake time (0 for raw/unfenced
+    links, e.g. socketpair codec tests)."""
+
+    kind = "socket"
+    relinkable = True
+
+    def __init__(self, sock: socket.socket, nonce: int = 0,
+                 write_timeout_s: float = DEFAULT_WRITE_TIMEOUT_S):
+        self._sock = sock
+        self.nonce = nonce
+        self.write_timeout_s = write_timeout_s
+        self._rfile = sock.makefile("rb", buffering=0)
+        self._reader = FrameReader(self._rfile)
+        self._closed = False
+        self._rx_shut = False
+
+    def send(self, msg: Dict[str, Any],
+             timeout_s: Optional[float] = None) -> None:
+        if self._closed:
+            raise WireEOF("socket transport already closed")
+        send_with_deadline(self._sock, pack_frame(msg),
+                           timeout_s if timeout_s is not None
+                           else self.write_timeout_s)
+
+    def recv(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        if self._closed:
+            raise WireEOF("socket transport already closed")
+        if self._rx_shut:
+            raise WireTimeout(
+                "socket receive direction shut (half-open link): the "
+                "reply never arrives")
+        return self._reader.read_frame(timeout_s=timeout_s)
+
+    def pending(self) -> Optional[Dict[str, Any]]:
+        return self._reader.pending()
+
+    def send_raw(self, data: bytes,
+                 timeout_s: Optional[float] = None) -> None:
+        """Raw bytes under the write deadline (fault-injection seam —
+        see PipeTransport.send_raw)."""
+        if self._closed:
+            raise WireEOF("socket transport already closed")
+        send_with_deadline(self._sock, data,
+                           timeout_s if timeout_s is not None
+                           else self.write_timeout_s)
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def shutdown_read(self) -> None:
+        """Half-open the link: our receive direction dies, sends still
+        flow — the netem "halfopen" fault shape (one direction only).
+
+        The transport-level ``_rx_shut`` flag makes the cut
+        deterministic: Linux TCP still delivers data that reached the
+        kernel buffer before (or even after) ``SHUT_RD``, so a reply
+        racing the shutdown would sometimes be readable and sometimes
+        surface EOF.  Marking the receive direction dead here means
+        every subsequent ``recv`` is ``WireTimeout``, regardless of
+        what the kernel buffered."""
+        self._rx_shut = True
+        try:
+            self._sock.shutdown(socket.SHUT_RD)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for closer in (self._rfile.close, self._sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+
+def client_handshake(sock: socket.socket, incarnation: int, nonce: int,
+                     timeout_s: float = DEFAULT_HANDSHAKE_TIMEOUT_S,
+                     write_timeout_s: float = DEFAULT_WRITE_TIMEOUT_S,
+                     ) -> tuple:
+    """Fence a fresh connection: send ``hello`` (incarnation + session
+    nonce), await the worker's ``ready``.  Returns ``(transport, ready)``
+    with the transport tagged by the adopted nonce.  Raises WireError on
+    a refused/garbled handshake — the caller owns retry/evidence."""
+    transport = SocketTransport(sock, nonce=nonce,
+                                write_timeout_s=write_timeout_s)
+    transport.send({"op": "hello", "inc": incarnation, "nonce": nonce},
+                   timeout_s=timeout_s)
+    ready = transport.recv(timeout_s=timeout_s)
+    if (ready.get("op") != "ready" or ready.get("inc") != incarnation
+            or ready.get("nonce") != nonce):
+        transport.close()
+        raise WireEOF(
+            f"handshake refused: expected ready(inc={incarnation}, "
+            f"nonce={nonce}), got {ready!r}")
+    return transport, ready
+
+
+def connect_transport(host: str, port: int, incarnation: int, nonce: int,
+                      timeout_s: float = DEFAULT_HANDSHAKE_TIMEOUT_S,
+                      write_timeout_s: float = DEFAULT_WRITE_TIMEOUT_S,
+                      ) -> tuple:
+    """Dial a listening socket worker and fence the link.  Returns
+    ``(transport, ready)``; any socket error propagates as OSError for
+    the caller to fold into link evidence."""
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    sock.settimeout(None)          # back to blocking; select owns waits
+    try:
+        return client_handshake(sock, incarnation, nonce,
+                                timeout_s=timeout_s,
+                                write_timeout_s=write_timeout_s)
+    except BaseException:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise

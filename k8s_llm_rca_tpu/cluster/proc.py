@@ -59,15 +59,21 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import select
 import signal
+import socket
 import subprocess
 import sys
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from k8s_llm_rca_tpu.cluster.net import (
+    DEFAULT_HANDSHAKE_TIMEOUT_S, PipeTransport, SocketTransport,
+    connect_transport,
+)
 from k8s_llm_rca_tpu.cluster.replica import Replica
 from k8s_llm_rca_tpu.cluster.wire import (
-    FrameReader, WireEOF, WireError, write_frame,
+    FrameReader, WireEOF, WireError, WireTimeout, write_frame,
 )
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 
@@ -78,6 +84,15 @@ log = get_logger(__name__)
 WORKER_ENV = "K8S_RCA_PROC_WORKER"
 
 WORKER_KINDS = ("oracle", "echo", "engine")
+
+# how the parent reaches the worker: the PR 12 stdio pipes, or a TCP
+# socket (cluster/net.py) — the cross-host shape, relinkable on link
+# failure because a dead SOCKET is not a dead PROCESS
+TRANSPORTS = ("pipe", "socket")
+
+# relink attempts (one per router pump) before a down link becomes hard
+# death evidence of kind "link" and the respawn path takes over
+DEFAULT_RELINK_BUDGET = 3
 
 # engine workers compile their TINY engine before answering the ready
 # handshake; scripted workers only pay the import of the serving stack
@@ -197,99 +212,348 @@ def _result_to_json(res) -> Dict[str, Any]:
             "expired": bool(res.expired)}
 
 
+def _handle_op(msg: Dict[str, Any], backend, state: Dict[str, int],
+               inc: int, hb) -> Tuple[Dict[str, Any], bool]:
+    """One decoded request -> ``(reply, drain)`` — shared by the pipe
+    loop and both socket serve loops so every transport speaks the exact
+    same op surface.  The reply is hb-stamped; the serve loop that owns
+    the link stamps the session nonce (socket modes only)."""
+    from k8s_llm_rca_tpu.serve.journal import decode_gen
+
+    op = msg.get("op")
+    reply: Dict[str, Any] = {"id": msg.get("id"), "inc": inc}
+    drain = False
+    try:
+        if op == "ping":
+            reply["ok"] = True
+        elif op == "start":
+            reply["handle"] = backend.start(msg["prompt"],
+                                            decode_gen(msg["gen"]))
+        elif op == "pump":
+            state["pumps"] += 1
+            results = backend.pump()
+            reply["results"] = {str(h): _result_to_json(r)
+                                for h, r in results.items()}
+            # Replica.queue_depth's duck typing, worker-side
+            if hasattr(backend, "queue_depth"):
+                reply["depth"] = int(backend.queue_depth())
+            else:
+                reply["depth"] = len(getattr(backend, "_live", None)
+                                     or getattr(backend, "_inflight",
+                                                ()))
+            occ = getattr(backend, "occupancy", None)
+            reply["occupancy"] = float(occ()) if occ else 0.0
+        elif op == "cancel":
+            backend.cancel(int(msg["handle"]))
+            reply["ok"] = True
+        elif op == "snapshot":
+            snap, handles = backend.snapshot_sequences()
+            reply["snap"] = snap
+            reply["handles"] = handles
+        elif op == "adopt":
+            opts = [decode_gen(g) for g in msg["gens"]]
+            reply["handles"] = backend.adopt_sequences(msg["snap"],
+                                                       opts)
+        elif op == "drain":
+            # graceful shutdown: finish nothing, ack, exit 0 — the
+            # parent has already migrated/cancelled what it wanted
+            reply["ok"] = True
+            drain = True
+        else:
+            raise ValueError(f"unknown wire op {op!r}")
+    except Exception as e:                    # noqa: BLE001 — crosses wire
+        reply = {"id": msg.get("id"), "inc": inc,
+                 "err": {"type": type(e).__name__, "msg": str(e)}}
+    reply["hb"] = hb()
+    return reply, drain
+
+
+def _adopt_connection(sock: socket.socket, inc: int, cur_nonce: int, hb,
+                      kind: str):
+    """Worker half of the link-fencing handshake on one fresh
+    connection.  Returns ``(transport, nonce)`` when adopted,
+    ``(None, cur_nonce)`` when refused — refusal answers on the NEW
+    connection and closes it, leaving any serving link untouched.
+
+    The fencing rule: adopt only a session nonce STRICTLY greater than
+    the one currently served.  A stale nonce is a connection the parent
+    already superseded (or a partitioned twin of the parent) — refusing
+    it here is the no-split-brain half the WORKER owns; the parent owns
+    the other half by discarding stale-nonce reply frames."""
+    transport = SocketTransport(sock)
+    try:
+        hello = transport.recv(timeout_s=DEFAULT_HANDSHAKE_TIMEOUT_S)
+    except (WireError, OSError):
+        transport.close()
+        return None, cur_nonce
+    nonce = hello.get("nonce")
+    if (hello.get("op") != "hello" or hello.get("inc") != inc
+            or not isinstance(nonce, int)):
+        _refuse(transport, inc, "BadHello",
+                f"expected hello(inc={inc}, nonce=int), got {hello!r}")
+        return None, cur_nonce
+    if nonce <= cur_nonce:
+        _refuse(transport, inc, "StaleNonce",
+                f"nonce {nonce} <= serving nonce {cur_nonce}: link "
+                f"already superseded")
+        return None, cur_nonce
+    transport.nonce = nonce
+    try:
+        transport.send({"op": "ready", "id": -1, "inc": inc,
+                        "pid": os.getpid(), "kind": kind, "nonce": nonce,
+                        "hb": hb()})
+    except (WireError, OSError):
+        transport.close()
+        return None, cur_nonce
+    return transport, nonce
+
+
+def _refuse(transport, inc: int, err_type: str, msg: str) -> None:
+    try:
+        transport.send({"id": -1, "inc": inc,
+                        "err": {"type": err_type, "msg": msg}})
+    except (WireError, OSError):
+        pass
+    transport.close()
+
+
+def _serve_frames(conn, backend, state: Dict[str, int], inc: int, hb,
+                  corrupt_after, hang_after) -> str:
+    """Answer every frame currently available on a readable link (one
+    select wakeup can deliver many frames — drain via ``pending()``).
+    Returns ``"ok"``, ``"linkdown"`` (the LINK died; the worker keeps
+    its state warm for a relink) or ``"drain"`` (exit requested)."""
+    try:
+        msg = conn.recv(timeout_s=DEFAULT_RPC_TIMEOUT_S)
+    except (WireError, OSError):
+        return "linkdown"
+    while msg is not None:
+        state["handled"] += 1
+        if corrupt_after is not None and state["handled"] > int(corrupt_after):
+            try:
+                conn.send_raw(b"\x00garbage-not-a-frame\xff\xfe")
+            except (WireError, OSError):
+                pass
+            os._exit(3)
+        if hang_after is not None and state["handled"] > int(hang_after):
+            while True:
+                time.sleep(3600)
+        reply, drain = _handle_op(msg, backend, state, inc, hb)
+        reply["nonce"] = conn.nonce
+        try:
+            conn.send(reply)
+        except (WireError, OSError):
+            return "linkdown"
+        if drain:
+            return "drain"
+        msg = conn.pending()
+    return "ok"
+
+
+_LEASH_CHUNK = 4096
+
+
+def _serve_listen(spec: Dict[str, Any], out, backend,
+                  state: Dict[str, int], inc: int, hb) -> int:
+    """``--listen`` socket mode: bind loopback (or ``listen_host``),
+    announce the port in a ``listening`` bootstrap frame on stdout (the
+    ONLY frame stdout ever carries in socket mode), then serve the op
+    protocol over whichever connection holds the highest session nonce.
+
+    Link death is NOT worker death: on conn EOF/corruption the worker
+    drops that link and keeps accepting, state warm, so the parent can
+    relink to the SAME incarnation.  stdin is the lifetime leash — EOF
+    there means the parent is gone and the worker exits 0 (a worker
+    never outlives its parent, even with no link up)."""
+    corrupt_after = spec.get("chaos_corrupt_after")
+    hang_after = spec.get("chaos_hang_after")
+    # chaos knob for the relink-budget-exhaustion tests: stop accepting
+    # (close the listener) after N adopted links, so every further
+    # relink dial dies at connect()
+    max_accepts = spec.get("chaos_max_accepts")
+    kind = spec.get("kind", "oracle")
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind((spec.get("listen_host", "127.0.0.1"),
+                   int(spec.get("listen_port", 0))))
+    listener.listen(8)
+    port = listener.getsockname()[1]
+    write_frame(out, {"op": "listening", "id": -1, "inc": inc,
+                      "pid": os.getpid(), "port": port, "kind": kind,
+                      "hb": hb()})
+    leash = sys.stdin.buffer
+    conn = None                   # link serving the highest nonce
+    nonce = 0
+    adopted = 0
+    try:
+        while True:
+            rlist = [leash]
+            if listener is not None:
+                rlist.append(listener)
+            if conn is not None:
+                rlist.append(conn)
+            readable, _, _ = select.select(rlist, [], [])
+            if leash in readable:
+                if not os.read(leash.fileno(), _LEASH_CHUNK):
+                    return 0      # parent went away
+            if listener is not None and listener in readable:
+                fresh, _ = listener.accept()
+                transport, nonce = _adopt_connection(fresh, inc, nonce,
+                                                     hb, kind)
+                if transport is not None:
+                    if conn is not None:
+                        # no split-brain: at most one live link per
+                        # worker — the newer nonce drops the old
+                        # connection the instant it is adopted
+                        conn.close()
+                    conn = transport
+                    adopted += 1
+                    if (max_accepts is not None
+                            and adopted >= int(max_accepts)):
+                        listener.close()
+                        listener = None
+                    continue      # re-select: old conn is gone
+            if conn is not None and conn in readable:
+                verdict = _serve_frames(conn, backend, state, inc, hb,
+                                        corrupt_after, hang_after)
+                if verdict == "drain":
+                    return 0
+                if verdict == "linkdown":
+                    conn.close()
+                    conn = None
+    finally:
+        if conn is not None:
+            conn.close()
+        if listener is not None:
+            listener.close()
+
+
+def _serve_connect(spec: Dict[str, Any], peer: Tuple[str, int], backend,
+                   state: Dict[str, int], inc: int, hb) -> int:
+    """``--connect`` socket mode: the cross-host inversion where the
+    WORKER dials a listening parent (NAT/firewall-friendly) and serves
+    the identical fenced protocol — the parent still initiates the
+    ``hello``/nonce, so the fencing rule is direction-agnostic.  On link
+    death the worker re-dials (the relink initiative flips sides with
+    the dial direction), giving up after ``connect_retries`` consecutive
+    failures; stdin EOF still exits."""
+    corrupt_after = spec.get("chaos_corrupt_after")
+    hang_after = spec.get("chaos_hang_after")
+    kind = spec.get("kind", "oracle")
+    retries = int(spec.get("connect_retries", 3))
+    leash = sys.stdin.buffer
+    nonce = 0
+    failures = 0
+    while True:
+        try:
+            sock = socket.create_connection(
+                peer, timeout=DEFAULT_HANDSHAKE_TIMEOUT_S)
+            sock.settimeout(None)
+        except OSError:
+            failures += 1
+            if failures > retries:
+                return 1
+            time.sleep(0.05 * failures)
+            continue
+        conn, nonce = _adopt_connection(sock, inc, nonce, hb, kind)
+        if conn is None:
+            failures += 1
+            if failures > retries:
+                return 1
+            continue
+        failures = 0
+        try:
+            while conn is not None:
+                readable, _, _ = select.select([leash, conn], [], [])
+                if leash in readable:
+                    if not os.read(leash.fileno(), _LEASH_CHUNK):
+                        return 0
+                if conn is not None and conn in readable:
+                    verdict = _serve_frames(conn, backend, state, inc,
+                                            hb, corrupt_after,
+                                            hang_after)
+                    if verdict == "drain":
+                        return 0
+                    if verdict == "linkdown":
+                        conn.close()
+                        conn = None
+        finally:
+            if conn is not None:
+                conn.close()
+
+
 def worker_main(argv: Sequence[str]) -> int:
     """Serve the wire protocol until a drain frame or stdin EOF.
 
     The real stdout fd is claimed for frames FIRST and ``sys.stdout`` is
     repointed at stderr, so a stray ``print`` anywhere in the serving
     stack garbles a log line instead of a frame.
+
+    Modes: bare ``'<spec-json>'`` serves over the stdio pipes (PR 12,
+    byte-identical); ``--listen '<spec-json>'`` binds a TCP listener and
+    announces the port on stdout; ``--connect HOST:PORT '<spec-json>'``
+    dials a listening parent.  Both socket modes serve the same framed
+    protocol with session-nonce link fencing (cluster/net.py).
     """
     out = sys.stdout.buffer
     sys.stdout = sys.stderr
-    if len(argv) != 1:
+    args = list(argv)
+    mode = "pipe"
+    peer: Optional[Tuple[str, int]] = None
+    if args and args[0] == "--listen":
+        mode = "listen"
+        args = args[1:]
+    elif args and args[0] == "--connect":
+        if len(args) < 2 or ":" not in args[1]:
+            raise SystemExit(
+                "usage: python -m k8s_llm_rca_tpu.cluster.proc "
+                "--connect HOST:PORT '<spec-json>'")
+        host, _, port = args[1].rpartition(":")
+        peer = (host, int(port))
+        mode = "connect"
+        args = args[2:]
+    if len(args) != 1:
         raise SystemExit("usage: python -m k8s_llm_rca_tpu.cluster.proc "
-                         "'<spec-json>'")
-    spec = json.loads(argv[0])
+                         "[--listen | --connect HOST:PORT] '<spec-json>'")
+    spec = json.loads(args[0])
     inc = int(spec.get("incarnation", 0))
-    rid = int(spec.get("replica_id", 0))
     # chaos knobs for the wire-failure tests: after N handled requests,
     # corrupt the stream (garbage bytes, hard exit) or go silent forever
     # (the missed-protocol-heartbeat path) — deterministic, no signals
     corrupt_after = spec.get("chaos_corrupt_after")
     hang_after = spec.get("chaos_hang_after")
 
-    from k8s_llm_rca_tpu.serve.journal import decode_gen
-
     backend, hb_fn = _build_worker_backend(spec)
-    pumps = 0
+    state = {"pumps": 0, "handled": 0}
 
     def hb() -> int:
-        return hb_fn() if hb_fn is not None else pumps
+        return hb_fn() if hb_fn is not None else state["pumps"]
+
+    if mode == "listen":
+        return _serve_listen(spec, out, backend, state, inc, hb)
+    if mode == "connect":
+        return _serve_connect(spec, peer, backend, state, inc, hb)
 
     write_frame(out, {"op": "ready", "id": -1, "inc": inc, "pid": os.getpid(),
                       "kind": spec.get("kind", "oracle"), "hb": hb()})
     reader = FrameReader(sys.stdin.buffer)
-    handled = 0
     while True:
         try:
             msg = reader.read_frame()
         except WireEOF:
             return 0      # parent went away: a worker never outlives it
-        handled += 1
-        if corrupt_after is not None and handled > int(corrupt_after):
+        state["handled"] += 1
+        if corrupt_after is not None and state["handled"] > int(corrupt_after):
             out.write(b"\x00garbage-not-a-frame\xff\xfe")
             out.flush()
             os._exit(3)
-        if hang_after is not None and handled > int(hang_after):
+        if hang_after is not None and state["handled"] > int(hang_after):
             while True:
                 time.sleep(3600)
-        op = msg.get("op")
-        reply: Dict[str, Any] = {"id": msg.get("id"), "inc": inc}
-        try:
-            if op == "ping":
-                reply["ok"] = True
-            elif op == "start":
-                reply["handle"] = backend.start(msg["prompt"],
-                                                decode_gen(msg["gen"]))
-            elif op == "pump":
-                pumps += 1
-                results = backend.pump()
-                reply["results"] = {str(h): _result_to_json(r)
-                                    for h, r in results.items()}
-                # Replica.queue_depth's duck typing, worker-side
-                if hasattr(backend, "queue_depth"):
-                    reply["depth"] = int(backend.queue_depth())
-                else:
-                    reply["depth"] = len(getattr(backend, "_live", None)
-                                         or getattr(backend, "_inflight",
-                                                    ()))
-                occ = getattr(backend, "occupancy", None)
-                reply["occupancy"] = float(occ()) if occ else 0.0
-            elif op == "cancel":
-                backend.cancel(int(msg["handle"]))
-                reply["ok"] = True
-            elif op == "snapshot":
-                snap, handles = backend.snapshot_sequences()
-                reply["snap"] = snap
-                reply["handles"] = handles
-            elif op == "adopt":
-                opts = [decode_gen(g) for g in msg["gens"]]
-                reply["handles"] = backend.adopt_sequences(msg["snap"],
-                                                           opts)
-            elif op == "drain":
-                # graceful shutdown: finish nothing, ack, exit 0 — the
-                # parent has already migrated/cancelled what it wanted
-                reply["ok"] = True
-                reply["hb"] = hb()
-                write_frame(out, reply)
-                return 0
-            else:
-                raise ValueError(f"unknown wire op {op!r}")
-        except Exception as e:                # noqa: BLE001 — crosses wire
-            reply = {"id": msg.get("id"), "inc": inc,
-                     "err": {"type": type(e).__name__, "msg": str(e)}}
-        reply["hb"] = hb()
+        reply, drain = _handle_op(msg, backend, state, inc, hb)
         write_frame(out, reply)
+        if drain:
+            return 0
     return 0
 
 
@@ -323,6 +587,30 @@ class ProcBackend:
         self.incarnation = int(self.spec.get("incarnation", 0))
         self.replica_id = int(self.spec.get("replica_id", 0))
         self.rpc_timeout_s = rpc_timeout_s
+        self.transport_kind = self.spec.get("transport", "pipe")
+        if self.transport_kind not in TRANSPORTS:
+            raise ValueError(
+                f"unknown proc transport {self.transport_kind!r}: "
+                f"expected one of {TRANSPORTS}")
+        self.relink_budget = int(self.spec.get("relink_budget",
+                                               DEFAULT_RELINK_BUDGET))
+        if self.relink_budget < 1:
+            raise ValueError(
+                f"relink_budget must be >= 1, got {self.relink_budget}: "
+                f"a zero budget makes every link blip process death, "
+                f"which is the pipe transport's semantics — use "
+                f"transport='pipe' instead")
+        # session-nonce link fencing (socket transports): monotonic per
+        # connection; the worker adopts only strictly-greater nonces
+        self._nonce = 0
+        self.relinks = 0
+        self.relink_attempts = 0
+        self._link_evidence: Optional[str] = None
+        # evidence kind for health.hard_kinds: "proc" (death observed /
+        # inferred at the process) vs "link" (relink budget exhausted)
+        self.death_kind: Optional[str] = None
+        self._transport = None
+        self._port: Optional[int] = None
         self._ids = itertools.count()
         # parent-side run mirror: handle -> True (remote) / False (local)
         self._live: Dict[int, bool] = {}
@@ -352,25 +640,61 @@ class ProcBackend:
         with obs_trace.span("cluster.proc.spawn", cat="cluster",
                             replica=self.replica_id, kind=self.kind,
                             incarnation=self.incarnation):
+            argv = [sys.executable, "-m", "k8s_llm_rca_tpu.cluster.proc"]
+            if self.transport_kind == "socket":
+                argv.append("--listen")
+            argv.append(json.dumps(self.spec, sort_keys=True))
             self._proc = subprocess.Popen(
-                [sys.executable, "-m", "k8s_llm_rca_tpu.cluster.proc",
-                 json.dumps(self.spec, sort_keys=True)],
+                argv,
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL,
                 env=worker_env(int(self.spec.get("devices", 1))))
-            self._reader = FrameReader(self._proc.stdout)
-            try:
-                ready = self._reader.read_frame(timeout_s=spawn_timeout_s)
-            except WireError as e:
-                rc = self._proc.poll()
-                self._reap()
-                raise WorkerError(
-                    f"proc replica {self.replica_id} worker failed its "
-                    f"ready handshake (rc={rc}): {e}") from e
-        if ready.get("op") != "ready" or ready.get("inc") != self.incarnation:
-            self._reap()
-            raise WorkerError(
-                f"proc replica {self.replica_id}: bad ready frame {ready!r}")
+            if self.transport_kind == "pipe":
+                self._transport = PipeTransport(self._proc.stdin,
+                                                self._proc.stdout)
+                try:
+                    ready = self._transport.recv(timeout_s=spawn_timeout_s)
+                except WireError as e:
+                    rc = self._proc.poll()
+                    self._reap()
+                    raise WorkerError(
+                        f"proc replica {self.replica_id} worker failed "
+                        f"its ready handshake (rc={rc}): {e}") from e
+                if (ready.get("op") != "ready"
+                        or ready.get("inc") != self.incarnation):
+                    self._reap()
+                    raise WorkerError(
+                        f"proc replica {self.replica_id}: bad ready "
+                        f"frame {ready!r}")
+            else:
+                # socket bootstrap: the worker's only stdout frame
+                # announces its port; stdin stays open afterwards as
+                # the worker's lifetime leash (EOF there = parent gone)
+                boot_reader = FrameReader(self._proc.stdout)
+                try:
+                    boot = boot_reader.read_frame(
+                        timeout_s=spawn_timeout_s)
+                except WireError as e:
+                    rc = self._proc.poll()
+                    self._reap()
+                    raise WorkerError(
+                        f"proc replica {self.replica_id} worker failed "
+                        f"its listening bootstrap (rc={rc}): {e}") from e
+                if (boot.get("op") != "listening"
+                        or boot.get("inc") != self.incarnation):
+                    self._reap()
+                    raise WorkerError(
+                        f"proc replica {self.replica_id}: bad listening "
+                        f"frame {boot!r}")
+                self._port = int(boot["port"])
+                try:
+                    ready = self._connect()
+                except (WireError, OSError) as e:
+                    self._reap()
+                    raise WorkerError(
+                        f"proc replica {self.replica_id} worker refused "
+                        f"the fenced connect on port {self._port}: {e}"
+                    ) from e
         self.pid = int(ready["pid"])
         self.last_heartbeat = ready.get("hb")
         self.spawn_s = time.perf_counter() - t0
@@ -387,6 +711,8 @@ class ProcBackend:
             if rc is not None:
                 evidence = f"{evidence}; exit:{rc}"
             self._dead_evidence = evidence
+            if self.death_kind is None:
+                self.death_kind = "proc"
             METRICS.inc("cluster.proc_deaths_observed")
             log.warning("proc replica %d: transport down (%s)",
                         self.replica_id, evidence)
@@ -404,31 +730,202 @@ class ProcBackend:
             return self._dead_evidence
         return None
 
+    def _connect(self) -> Dict[str, Any]:
+        """Dial the worker's listener and fence a fresh link under the
+        NEXT session nonce.  Replaces (and closes) any previous
+        transport only AFTER the handshake succeeds, so a failed relink
+        attempt leaves the evidence state untouched.  The nonce burns
+        even on failure — monotonicity is all the fence needs."""
+        self._nonce += 1
+        transport, ready = connect_transport(
+            "127.0.0.1", self._port, self.incarnation, self._nonce,
+            timeout_s=min(self.rpc_timeout_s, DEFAULT_HANDSHAKE_TIMEOUT_S),
+            write_timeout_s=self.rpc_timeout_s)
+        old, self._transport = self._transport, transport
+        if old is not None:
+            old.close()
+        if ready.get("hb") is not None:
+            self.last_heartbeat = int(ready["hb"])
+        return ready
+
+    def _mark_link_down(self, evidence: str) -> None:
+        """Record LINK evidence: ``poll()`` just said the process is
+        alive, only the socket between us died.  The router's relink
+        path consumes this; it never feeds the watchdog's hard-death
+        escalation until the relink budget is exhausted."""
+        if (self._dead_evidence is not None
+                or self._link_evidence is not None):
+            return
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        self._link_evidence = evidence
+        if self._transport is not None:
+            self._transport.close()
+        METRICS.inc("cluster.net_link_downs")
+        obs_trace.event("cluster.net.partition", replica=self.replica_id,
+                        incarnation=self.incarnation, nonce=self._nonce,
+                        evidence=evidence)
+        log.warning("proc replica %d: LINK down, process alive (%s)",
+                    self.replica_id, evidence)
+
+    def link_liveness(self) -> Optional[str]:
+        """Link-down evidence, or None while the link is up.  Proc
+        evidence outranks link evidence — callers (router pump, health
+        probe) check ``proc_liveness`` first."""
+        return self._link_evidence
+
+    def relink(self) -> bool:
+        """Reconnect a down link to the SAME incarnation under a fresh
+        session nonce.  Returns True when the link is (now) up.  Budget
+        exhaustion converts the outage into hard death evidence of kind
+        "link", handing the watchdog/supervisor respawn path the
+        replica — 'not DEAD until the relink budget is exhausted'."""
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        if self._dead_evidence is not None:
+            return False
+        if self.transport_kind != "socket":
+            return False
+        if self._proc.poll() is not None:
+            self._mark_dead("process exited")
+            return False
+        if self._link_evidence is None:
+            return True
+        self.relink_attempts += 1
+        try:
+            self._connect()
+        except (WireError, OSError) as e:
+            if self.relink_attempts >= self.relink_budget:
+                self.death_kind = "link"
+                self._mark_dead(
+                    f"relink budget exhausted "
+                    f"({self.relink_attempts}/{self.relink_budget} "
+                    f"attempts): {type(e).__name__}: {e}")
+            return False
+        healed = self._link_evidence
+        self._link_evidence = None
+        self.relink_attempts = 0
+        self.relinks += 1
+        METRICS.inc("cluster.net_relinks")
+        obs_trace.event("cluster.net.relink", replica=self.replica_id,
+                        incarnation=self.incarnation, nonce=self._nonce,
+                        healed=healed)
+        log.warning("proc replica %d: relinked (incarnation %d, nonce "
+                    "%d) after %s", self.replica_id, self.incarnation,
+                    self._nonce, healed)
+        return True
+
+    def drop_link(self, halfopen: bool = False) -> None:
+        """Sever the parent side of the link WITHOUT touching the
+        process — the killer's partition/halfopen fault.  Full partition
+        closes the socket (both directions die); halfopen shuts only our
+        receive direction (sends still flow), so the failure surfaces as
+        the reply that never arrives (``WireTimeout``/EOF), not a send
+        error."""
+        if self.transport_kind != "socket":
+            raise ValueError(
+                f"proc replica {self.replica_id}: cannot partition a "
+                f"{self.transport_kind!r} transport — a pipe to a child "
+                f"cannot die without the child dying (spawn with "
+                f"transport='socket')")
+        if self._transport is None:
+            return
+        if halfopen:
+            self._transport.shutdown_read()
+        else:
+            self._transport.close()
+        METRICS.inc("cluster.net_partitions")
+        log.warning("proc replica %d: link %s injected (nonce %d)",
+                    self.replica_id,
+                    "half-open" if halfopen else "partition",
+                    self._nonce)
+
+    def replayable(self, handle: int) -> bool:
+        """Whether a relink replay may re-start this handle: injected
+        failed/stalled runs settle locally — replaying them would erase
+        their injected outcomes and break soak byte-identity."""
+        return handle not in self._failed and handle not in self._stalled
+
+    def link_stats(self) -> Optional[Dict[str, Any]]:
+        """Per-link gauges for obs/export.py (socket transports only)."""
+        if self.transport_kind != "socket":
+            return None
+        alive = (self._link_evidence is None
+                 and self._dead_evidence is None)
+        return {"nonce": self._nonce, "alive": 1 if alive else 0,
+                "relinks": self.relinks}
+
+    def _recv_reply(self, req: Dict[str, Any], timeout_s: float
+                    ) -> Dict[str, Any]:
+        """Receive the reply to ``req`` under ONE overall deadline.
+
+        Pipe mode returns the next frame — the transport is lockstep by
+        construction, so any mismatch downstream is a protocol desync.
+        Socket mode tolerates what a network can legally do to a fenced
+        link: frames tagged with a stale session nonce (a link this
+        parent already abandoned) and duplicate deliveries of already-
+        consumed ids (netem ``duplicate``) are DISCARDED, never desync
+        evidence; a FUTURE id is still a breach."""
+        if self.transport_kind != "socket":
+            return self._transport.recv(timeout_s=timeout_s)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise WireTimeout(
+                    f"no current-nonce reply to {req['op']} id "
+                    f"{req['id']} within {timeout_s}s")
+            resp = self._transport.recv(timeout_s=remaining)
+            rnonce = resp.get("nonce")
+            if rnonce != self._nonce:
+                METRICS.inc("cluster.net_stale_replies_discarded")
+                log.info("proc replica %d: discarded stale-nonce reply "
+                         "(%r != %d)", self.replica_id, rnonce,
+                         self._nonce)
+                continue
+            rid = resp.get("id")
+            if isinstance(rid, int) and rid < req["id"]:
+                METRICS.inc("cluster.net_dup_replies_discarded")
+                continue
+            return resp
+
     def _rpc(self, op: str, timeout_s: Optional[float] = None,
              **fields) -> Dict[str, Any]:
         """One request/response turn.  Raises WorkerError for an error
         the WORKER reported; raises WireError/OSError for transport
         death AFTER recording the evidence (callers on the router path
-        catch and go silent; the watchdog owns the verdict)."""
+        catch and go silent; the watchdog owns the verdict).  On a
+        socket transport, a wire failure with a LIVE ``poll()`` records
+        link evidence instead — relink territory, not respawn."""
         from k8s_llm_rca_tpu.obs import trace as obs_trace
         from k8s_llm_rca_tpu.serve.backend import BudgetError
 
         if self._dead_evidence is not None:
             raise WireEOF(f"proc replica {self.replica_id} transport "
                           f"already down: {self._dead_evidence}")
+        if self._link_evidence is not None:
+            raise WireTimeout(
+                f"proc replica {self.replica_id} link down (awaiting "
+                f"relink): {self._link_evidence}")
         req = dict(fields)
         req["op"] = op
         req["id"] = next(self._ids)
+        effective = (timeout_s if timeout_s is not None
+                     else self.rpc_timeout_s)
         with obs_trace.span("cluster.proc.rpc", cat="cluster", op=op,
                             replica=self.replica_id):
             try:
-                write_frame(self._proc.stdin, req)
-                resp = self._reader.read_frame(
-                    timeout_s=(timeout_s if timeout_s is not None
-                               else self.rpc_timeout_s))
+                self._transport.send(req, timeout_s=effective)
+                resp = self._recv_reply(req, effective)
             except (WireError, OSError, ValueError) as e:
                 # ValueError: write to a pipe closed mid-Popen teardown
-                self._mark_dead(f"{op} rpc failed: {type(e).__name__}: {e}")
+                if (self.transport_kind == "socket"
+                        and self._proc.poll() is None):
+                    self._mark_link_down(
+                        f"{op} rpc failed: {type(e).__name__}: {e}")
+                else:
+                    self._mark_dead(
+                        f"{op} rpc failed: {type(e).__name__}: {e}")
                 raise
         self.rpcs += 1
         if resp.get("inc") != self.incarnation:
@@ -597,10 +1094,18 @@ class ProcBackend:
         from k8s_llm_rca_tpu.obs import trace as obs_trace
 
         if self._proc.poll() is None and self._dead_evidence is None:
-            try:
-                self._rpc("drain", timeout_s=timeout_s)
-            except (WireError, OSError, WorkerError):
-                pass
+            if self._link_evidence is not None:
+                # no link to carry the drain frame: drop the stdin leash
+                # instead — the worker exits 0 on leash EOF
+                try:
+                    self._proc.stdin.close()
+                except OSError:
+                    pass
+            else:
+                try:
+                    self._rpc("drain", timeout_s=timeout_s)
+                except (WireError, OSError, WorkerError):
+                    pass
             try:
                 self._proc.wait(timeout=timeout_s)
             except subprocess.TimeoutExpired:
@@ -621,6 +1126,8 @@ class ProcBackend:
             self._proc.wait()
         except Exception:         # noqa: BLE001 — teardown best-effort
             pass
+        if self._transport is not None:
+            self._transport.close()
         for stream in (self._proc.stdin, self._proc.stdout):
             try:
                 if stream is not None:
@@ -673,10 +1180,29 @@ class ProcReplica(Replica):
 
     def healthy(self) -> bool:
         return (super().healthy()
-                and self.backend.proc_liveness() is None)
+                and self.backend.proc_liveness() is None
+                and self.backend.link_liveness() is None)
 
     def proc_liveness(self) -> Optional[str]:
         return self.backend.proc_liveness()
+
+    def link_liveness(self) -> Optional[str]:
+        return self.backend.link_liveness()
+
+    def relink(self) -> bool:
+        return self.backend.relink()
+
+    def partition_link(self, halfopen: bool = False) -> None:
+        self.backend.drop_link(halfopen=halfopen)
+
+    @property
+    def supports_relink(self) -> bool:
+        return self.backend.transport_kind == "socket"
+
+    def evidence_kind(self) -> str:
+        """``"link"`` when the death verdict came from relink-budget
+        exhaustion, ``"proc"`` otherwise (health.hard_kinds)."""
+        return self.backend.death_kind or "proc"
 
     def kill_process(self) -> None:
         self.backend.kill()
@@ -688,6 +1214,11 @@ class ProcReplica(Replica):
 def build_proc_replicas(n_replicas: int, kind: str = "oracle",
                         **spec: Any) -> List[ProcReplica]:
     """N out-of-process replicas of one kind.
+
+    ``transport="socket"`` in the spec puts each worker behind a TCP
+    loopback listener with session-nonce link fencing (the cross-host
+    shape; link death relinks instead of respawning); the default
+    ``"pipe"`` keeps the PR 12 stdio protocol byte-identical.
 
     Loud exclusions (repo convention): proc replicas compose with the
     router/watchdog/supervisor stack, NOT with multi-device sharding —

@@ -330,6 +330,24 @@ class ClusterRouter:
                 # watchdog forever — skip pump AND beat, so the silence
                 # plus the hard exit evidence escalates SUSPECT -> DEAD
                 continue
+            link = getattr(replica, "link_liveness", None)
+            if link is not None and link() is not None:
+                # live process, dead LINK (cluster/net.py): not death
+                # evidence — relink the SAME incarnation and replay its
+                # in-flight runs in place.  While the relink budget
+                # holds, BEAT the watchdog even on a failed attempt so
+                # the soft-miss path cannot race the budget to a DEAD
+                # verdict; budget exhaustion converts the outage into
+                # hard "link" evidence, which escalates like any death.
+                if replica.relink():
+                    self._replay_relinked(rid)
+                else:
+                    if (self.health is not None
+                            and replica.proc_liveness() is None):
+                        self.health.beat(
+                            rid, ticks=getattr(replica.backend,
+                                               "last_heartbeat", None))
+                    continue
             # mirror the router's view into the replica engine before its
             # tick, so this tick's TickSample carries this tick's load
             engine = getattr(replica.backend, "engine", None)
@@ -385,6 +403,38 @@ class ClusterRouter:
         return total
 
     # ------------------------------------------------------------- failover
+
+    def _replay_relinked(self, rid: int) -> None:
+        """After a successful relink: replay ``rid``'s in-flight runs on
+        the SAME warm incarnation under their existing global handles —
+        the journal-boundary twin of ``fail_replica``, minus the
+        failover.  A partition can black-hole a start OR swallow a pump
+        reply the worker already settled, so every non-injected orphan
+        is cancelled (pop-tolerant both sides) and re-started through
+        ``inject.readmission``; greedy determinism regenerates settled
+        results byte-identically.  Injected-failed/stalled handles are
+        excluded — they settle locally, and replaying them would erase
+        their injected outcomes."""
+        replica = self.replicas[rid]
+        backend = replica.backend
+        replay_ok = getattr(backend, "replayable", None)
+        replayed = 0
+        for ghandle in self._orphans(rid):
+            _, lhandle = self._handle_map[ghandle]
+            if replay_ok is not None and not replay_ok(lhandle):
+                continue
+            self._local.pop((rid, lhandle), None)
+            backend.cancel(lhandle)
+            prompt, opts = self._runs[ghandle]
+            with inject.readmission():
+                new_lhandle = backend.start(prompt, opts)
+            self._handle_map[ghandle] = (rid, new_lhandle)
+            self._local[(rid, new_lhandle)] = ghandle
+            replayed += 1
+        if self.supervisor is not None:
+            self.supervisor.relinks.append(rid)
+        log.warning("replica %d relinked: %d run(s) replayed on the "
+                    "same incarnation", rid, replayed)
 
     def _orphans(self, rid: int) -> List[int]:
         """Global handles currently assigned to ``rid``, in admission

@@ -21,8 +21,18 @@ misses its protocol heartbeat and raises ``WireTimeout`` instead of
 wedging the parent.
 
 ``FrameReader`` buffers partial reads across calls, so it works over
-pipes (non-blocking-ish via select + ``read1``) and over in-memory
-streams (io.BytesIO) for the codec unit tests.
+pipes (non-blocking-ish via select + ``read1``), over sockets
+(cluster/net.py wraps a socket in an unbuffered ``makefile`` so the fd
+stays select-accurate), and over in-memory streams (io.BytesIO) for the
+codec unit tests.  A frame split across many arrivals consumes ONE
+deadline: ``read_frame`` fixes the deadline on entry and every
+``_fill`` select gets only the remaining slice, so a trickling peer can
+never stretch a single read past ``timeout_s`` total.  ``timeout_s <=
+0`` is rejected loudly (a zero deadline is ambiguous between "poll
+once" and "already expired"; callers that want a non-blocking look use
+``pending()``), and ``max_buffered_bytes`` bounds the staging buffer so
+a garbage-spewing peer is declared corrupt instead of growing ``_buf``
+without limit.
 """
 
 from __future__ import annotations
@@ -85,11 +95,25 @@ class FrameReader:
     contract.  Partial bytes are buffered across calls.  ``timeout_s``
     needs a real file descriptor (select); in-memory streams are always
     "ready" and simply read to exhaustion.
+
+    ``max_buffered_bytes`` bounds the staging buffer: a peer spewing
+    bytes that never complete a decodable frame (e.g. a plausible header
+    whose payload never arrives intact) is declared ``WireCorrupt`` once
+    the buffer exceeds the bound, instead of accumulating memory until
+    the oversize-header check happens to trigger.  The default admits
+    any legal frame plus one read chunk of lookahead.
     """
 
-    def __init__(self, stream):
+    # one maximal frame, fully buffered, plus a chunk of the next one —
+    # anything beyond this cannot be a legal frame still assembling
+    DEFAULT_MAX_BUFFERED = MAX_FRAME_SIZE + HEADER_SIZE + _CHUNK
+
+    def __init__(self, stream, max_buffered_bytes: int = 0):
         self._stream = stream
         self._buf = bytearray()
+        self._max_buffered = (max_buffered_bytes
+                              if max_buffered_bytes > 0
+                              else self.DEFAULT_MAX_BUFFERED)
         try:
             self._fd: Optional[int] = stream.fileno()
         except (AttributeError, OSError, io.UnsupportedOperation):
@@ -123,14 +147,26 @@ class FrameReader:
                 f"{type(msg).__name__}")
         return msg
 
+    def pending(self) -> Optional[Dict[str, Any]]:
+        """Decode the next frame from ALREADY-buffered bytes only — never
+        touches the stream, never blocks.  Returns None when the buffer
+        holds no complete frame.  This is the non-blocking look callers
+        used to fake with a tiny timeout: the worker's socket serve loop
+        drains every frame a single select wakeup delivered, and the
+        parent uses it to sweep stale-nonce replies out of the buffer."""
+        return self._try_decode()
+
     def _fill(self, timeout_s: Optional[float]) -> None:
         """Read at least one more byte into the buffer, honoring the
-        timeout when the stream has a pollable fd."""
+        timeout when the stream has a pollable fd.  ``timeout_s`` here is
+        a remaining-deadline SLICE computed by ``read_frame`` — a frame
+        split across arrivals spends one shared deadline, not a fresh
+        ``timeout_s`` per fill."""
         if self._fd is not None and timeout_s is not None:
             ready, _, _ = select.select([self._fd], [], [], timeout_s)
             if not ready:
                 raise WireTimeout(
-                    f"no frame within {timeout_s}s: peer missed its "
+                    f"no frame within {timeout_s:.6g}s: peer missed its "
                     f"protocol heartbeat")
         read1 = getattr(self._stream, "read1", None)
         chunk = read1(_CHUNK) if read1 is not None \
@@ -142,11 +178,21 @@ class FrameReader:
                     f"byte(s) mid-frame")
             raise WireEOF("peer closed the stream at a frame boundary")
         self._buf.extend(chunk)
+        if len(self._buf) > self._max_buffered:
+            raise WireCorrupt(
+                f"{len(self._buf)} buffered bytes exceed "
+                f"max_buffered_bytes {self._max_buffered} without a "
+                f"decodable frame: peer is spewing garbage")
 
     def read_frame(self, timeout_s: Optional[float] = None
                    ) -> Dict[str, Any]:
         import time as _time
 
+        if timeout_s is not None and timeout_s <= 0:
+            raise ValueError(
+                f"timeout_s must be > 0, got {timeout_s}: a zero/negative "
+                f"deadline is ambiguous (use pending() for a non-blocking "
+                f"buffered look, None to block)")
         deadline = (None if timeout_s is None
                     else _time.monotonic() + timeout_s)
         while True:

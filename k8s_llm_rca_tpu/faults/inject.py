@@ -37,7 +37,15 @@ Sites in the real stack:
   kill — a scheduled "crash" delivers SIGKILL to an out-of-process
   replica's worker (cluster/proc.py), and the health watchdog must
   detect the actual OS death (pipe EOF / exit code) and heal.  Same
-  own-plan, incident-boundary discipline as SITE_REPLICA.
+  own-plan, incident-boundary discipline as SITE_REPLICA;
+- ``SITE_NET`` (``faults/netem.py`` + ``faults/supervisor.py::
+  NetKiller``): deterministic network faults on the parent<->worker
+  link of a SOCKET-transport replica — partition/halfopen at incident
+  boundaries (NetKiller severs the real loopback socket; the router's
+  relink path must heal the SAME incarnation under a fresh session
+  nonce), and the full netem vocabulary (delay/trickle/duplicate/
+  corrupt/heal) when a ``NetemTransport`` wraps the link.  Own-plan
+  discipline again: link faults never touch the armed plan's counters.
 """
 
 from __future__ import annotations
@@ -53,6 +61,7 @@ SITE_ENGINE_TICK = "engine.tick"
 SITE_PROCESS = "serve.process"
 SITE_REPLICA = "cluster.replica"
 SITE_PROC = "cluster.proc"
+SITE_NET = "cluster.net"
 
 # the armed plan; hot paths read this directly (see module docstring)
 _ARMED: Optional[FaultPlan] = None

@@ -1,0 +1,179 @@
+"""Deterministic in-process network-fault proxy for the cluster's
+socket transport (cluster/net.py) — the ``tc netem`` of this repo,
+minus the kernel and the nondeterminism.
+
+``NetemTransport`` wraps any Transport (socket or pipe) and applies the
+link faults a real network can produce, drawn from a seeded
+``FaultPlan`` at ``SITE_NET`` so every soak replays byte-identically:
+
+- ``partition``: the link dies in BOTH directions (sends and recvs
+  raise ``WireTimeout``) until a ``heal`` draw;
+- ``halfopen``: ONE direction dies — sends still flow, replies never
+  arrive (recv raises ``WireTimeout``) until a ``heal`` draw;
+- ``delay``: the next turn pays ``delay_s`` on the plan's clock (the
+  VirtualClock in soaks — no wall time, no flakes);
+- ``trickle``: the next frame goes out in ``TRICKLE_SEGMENTS`` tiny
+  unaligned writes — the FrameReader's single-deadline assembly must
+  reassemble it;
+- ``duplicate``: the next reply is delivered twice — the parent's
+  stale-id discard must drop the second copy;
+- ``corrupt``: the next recv surfaces a bit-flipped frame
+  (``WireCorrupt``) — link evidence, not process death;
+- ``heal``: clears any sticky partition/halfopen state.
+
+Poll discipline (the soak byte-identity contract, same as
+``ReplicaKiller``): the proxy polls its OWN plan — never the armed
+chaos plan — once per ``send`` (one RPC turn), so link faults cannot
+perturb ``SITE_BACKEND``/``SITE_ENGINE_TICK`` poll counters.
+
+Composition: the unit tests wrap a raw ``SocketTransport`` over a
+``socket.socketpair``; the chaos soak instead rides ``NetKiller``
+(faults/supervisor.py), which severs the REAL loopback link of a live
+worker so the full detect -> relink -> replay path is exercised.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from k8s_llm_rca_tpu.cluster.wire import (
+    WireCorrupt, WireTimeout, pack_frame,
+)
+from k8s_llm_rca_tpu.faults import inject
+from k8s_llm_rca_tpu.faults.plan import FaultPlan
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+# default virtual-clock cost of a "delay" draw with no delay_s
+DEFAULT_DELAY_S = 0.05
+
+# a trickled frame goes out in this many unaligned segments — splits
+# the header/payload boundary (headers are 12 bytes) without the
+# per-write skb-accounting blowup of literal byte-at-a-time sends
+TRICKLE_SEGMENTS = 16
+
+
+class NetemTransport:
+    """Transport wrapper applying seeded ``SITE_NET`` faults per turn.
+
+    Presents the exact Transport surface (send/recv/pending/close plus
+    kind/relinkable/nonce passthroughs), so it drops into any caller of
+    cluster/net.py transports unchanged.
+    """
+
+    def __init__(self, inner, plan: Optional[FaultPlan] = None):
+        self.inner = inner
+        self.plan = plan
+        self._down = False            # sticky: partition (both ways)
+        self._half = False            # sticky: halfopen (recv only)
+        self._trickle_next = False
+        self._dup_next = False
+        self._corrupt_next = False
+        self._dup_frame: Optional[Dict[str, Any]] = None
+        self.faults_applied: Dict[str, int] = {}
+
+    # --------------------------------------------------------- passthrough
+
+    @property
+    def kind(self) -> str:
+        return self.inner.kind
+
+    @property
+    def relinkable(self) -> bool:
+        return self.inner.relinkable
+
+    @property
+    def nonce(self) -> int:
+        return getattr(self.inner, "nonce", 0)
+
+    def pending(self) -> Optional[Dict[str, Any]]:
+        return self.inner.pending()
+
+    def fileno(self) -> int:
+        return self.inner.fileno()
+
+    def close(self) -> None:
+        self.inner.close()
+
+    # ------------------------------------------------------------- faults
+
+    def _clock_sleep(self, seconds: float) -> None:
+        clock = getattr(self.plan, "clock", None)
+        (clock.sleep if clock is not None else time.sleep)(seconds)
+
+    def _apply(self, fault) -> None:
+        if fault is None:
+            return
+        kind = fault.kind
+        self.faults_applied[kind] = self.faults_applied.get(kind, 0) + 1
+        METRICS.inc("faults.netem_applied")
+        log.warning("netem: %s at %s[%d]", kind, fault.site, fault.index)
+        if kind == "partition":
+            self._down = True
+        elif kind == "halfopen":
+            self._half = True
+        elif kind == "heal":
+            self._down = False
+            self._half = False
+        elif kind == "delay":
+            self._clock_sleep(fault.delay_s or DEFAULT_DELAY_S)
+        elif kind == "trickle":
+            self._trickle_next = True
+        elif kind == "duplicate":
+            self._dup_next = True
+        elif kind == "corrupt":
+            self._corrupt_next = True
+        else:
+            raise ValueError(
+                f"netem cannot apply fault kind {kind!r}: SITE_NET "
+                f"draws from partition/halfopen/delay/trickle/"
+                f"duplicate/corrupt/heal")
+
+    # -------------------------------------------------------------- wire
+
+    def send(self, msg: Dict[str, Any],
+             timeout_s: Optional[float] = None) -> None:
+        # one poll per send = one poll per RPC turn, own plan only
+        if self.plan is not None:
+            self._apply(self.plan.poll(inject.SITE_NET))
+        if self._down:
+            raise WireTimeout("netem: link partitioned (awaiting heal)")
+        if self._trickle_next:
+            self._trickle_next = False
+            data = pack_frame(msg)
+            # small unaligned segments: enough to split every frame
+            # boundary the reader cares about, few enough that per-send
+            # skb accounting (AF_UNIX charges full truesize per write)
+            # cannot wedge the sender before the peer starts reading
+            step = max(1, -(-len(data) // TRICKLE_SEGMENTS))
+            for i in range(0, len(data), step):
+                self.inner.send_raw(data[i:i + step], timeout_s=timeout_s)
+            return
+        self.inner.send(msg, timeout_s=timeout_s)
+
+    def recv(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        if self._down:
+            raise WireTimeout("netem: link partitioned (awaiting heal)")
+        if self._half:
+            raise WireTimeout(
+                "netem: link half-open (sends flow, replies dropped)")
+        if self._corrupt_next:
+            self._corrupt_next = False
+            raise WireCorrupt(
+                "netem: injected bit-flip — frame CRC mismatch")
+        if self._dup_frame is not None:
+            frame, self._dup_frame = self._dup_frame, None
+            return frame
+        resp = self.inner.recv(timeout_s=timeout_s)
+        if self._dup_next:
+            self._dup_next = False
+            self._dup_frame = dict(resp)
+        return resp
+
+    def send_raw(self, data: bytes,
+                 timeout_s: Optional[float] = None) -> None:
+        if self._down:
+            raise WireTimeout("netem: link partitioned (awaiting heal)")
+        self.inner.send_raw(data, timeout_s=timeout_s)

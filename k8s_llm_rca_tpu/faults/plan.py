@@ -30,9 +30,15 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 # makes sense for them (graph queries: error/timeout/slow/poison/empty;
 # backend runs: error/budget/stall; engine ticks: oom/preempt/stall/crash;
 # the serve process boundary: crash — a supervised kill/restart,
-# faults/supervisor.py)
+# faults/supervisor.py; the parent<->worker network link at SITE_NET:
+# partition (both directions die), halfopen (one direction), delay,
+# trickle (byte-at-a-time), duplicate (frame delivered twice), corrupt
+# (bit-flipped frame), and heal (clear any sticky link fault) —
+# faults/netem.py)
 FAULT_KINDS = ("error", "timeout", "slow", "poison", "empty",
-               "budget", "stall", "oom", "preempt", "crash")
+               "budget", "stall", "oom", "preempt", "crash",
+               "partition", "halfopen", "delay", "trickle",
+               "duplicate", "corrupt", "heal")
 
 
 @dataclass(frozen=True)

@@ -110,7 +110,7 @@ def _build_oracle_service(run_timeout_s: float, clock, journal=None):
 def _build_cluster_service(run_timeout_s: float, clock, journal=None,
                            n_replicas: int = 2, oracle: bool = False,
                            selfheal: bool = False, health_policy=None,
-                           proc: bool = False):
+                           proc: bool = False, transport: str = "pipe"):
     """N-replica serving behind a ClusterRouter (cluster/).  ``oracle``
     replicas are scripted backends — the cheap mode the 100-incident
     replica-kill soak runs on (tier-1 budget); engine replicas reuse the
@@ -124,7 +124,10 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
     semantics are transport-invariant, which is why the proc soak's
     report is byte-identical to the in-process cluster-oracle run (the
     report even says ``cluster-oracle`` — transport is a deployment
-    detail, not an outcome).
+    detail, not an outcome).  ``transport`` picks the wire ("pipe" or
+    "socket", cluster/net.py): socket workers serve the same framed
+    protocol over a loopback TCP link, which a NetKiller can partition
+    and the router relink — the report stays byte-identical either way.
 
     ``selfheal``: arm the self-healing loop (cluster/health.py) — a
     HealthWatchdog on the soak's VirtualClock plus a restart-enabled
@@ -142,7 +145,8 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
     if proc:
         from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
 
-        replicas = build_proc_replicas(n_replicas, kind="oracle")
+        replicas = build_proc_replicas(n_replicas, kind="oracle",
+                                       transport=transport)
         engines = []
     elif oracle:
         from k8s_llm_rca_tpu.rca.oracle import OracleBackend
@@ -240,7 +244,11 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     cheap mode bench.py publishes alongside the engine soak), or their
     multi-replica forms "cluster" / "cluster-oracle" — ``cluster_replicas``
     engines (or scripted oracles) on disjoint submeshes behind a
-    ClusterRouter (cluster/router.py).
+    ClusterRouter (cluster/router.py).  "proc-cluster" runs the oracle
+    replicas out-of-process over stdio pipes (cluster/proc.py);
+    "net-cluster" runs them over loopback TCP sockets (cluster/net.py),
+    the fleet a NetKiller can partition and the router relinks — both
+    report as "cluster-oracle" (byte-identity is the acceptance bar).
 
     ``killer``: optional faults.supervisor.ReplicaKiller (cluster modes
     only) polled once at every incident boundary on its OWN FaultPlan;
@@ -347,12 +355,14 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
         service, engine, factory = _build_engine_service(
             run_timeout_s, clock, journal)
         engines = [engine]
-    elif backend in ("cluster", "cluster-oracle", "proc-cluster"):
+    elif backend in ("cluster", "cluster-oracle", "proc-cluster",
+                     "net-cluster"):
         service, engines, factory, router = _build_cluster_service(
             run_timeout_s, clock, journal,
             n_replicas=cluster_replicas,
             oracle=(backend == "cluster-oracle"),
-            proc=(backend == "proc-cluster"),
+            proc=(backend in ("proc-cluster", "net-cluster")),
+            transport=("socket" if backend == "net-cluster" else "pipe"),
             selfheal=selfheal)
         engine = None   # "engine_clean" is per-replica below
     elif selfheal:
@@ -411,7 +421,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     incidents: List[Dict[str, Any]] = []
     n_resolved = n_degraded = n_failed = 0
     with inject.armed(plan), obs_ctx, _reaping_workers(
-            router if backend == "proc-cluster" else None):
+            router if backend in ("proc-cluster", "net-cluster")
+            else None):
         if concurrency > 1:
             from k8s_llm_rca_tpu.rca.scheduler import (
                 IncidentFailure, SweepScheduler,
@@ -501,11 +512,13 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
 
     report = {
         "seed": seed,
-        # proc-cluster reports as cluster-oracle ON PURPOSE: the workers
-        # run the same scripted oracle over a different transport, and
-        # the acceptance bar is byte-identity against the in-process
-        # run — a transport tag would be the one engineered difference
-        "backend": ("cluster-oracle" if backend == "proc-cluster"
+        # proc-cluster AND net-cluster report as cluster-oracle ON
+        # PURPOSE: the workers run the same scripted oracle over a
+        # different transport (pipe or socket), and the acceptance bar
+        # is byte-identity against the in-process run — a transport tag
+        # would be the one engineered difference
+        "backend": ("cluster-oracle"
+                    if backend in ("proc-cluster", "net-cluster")
                     else backend),
         "n_incidents": n_incidents,
         "completed": n_resolved + n_degraded,
@@ -634,9 +647,9 @@ def run_pipelined_sweep(seed: int = 0, n_incidents: int = 10,
         service, _engine, _factory = _build_oracle_service(
             run_timeout_s, clock, journal)
         engines = []
-    elif backend == "proc-cluster":
+    elif backend in ("proc-cluster", "net-cluster"):
         raise ValueError(
-            "backend='proc-cluster' is chaos-soak-only (run_chaos_soak): "
+            f"backend={backend!r} is chaos-soak-only (run_chaos_soak): "
             "the pipelined sweep returns live run handles that would "
             "outlive the worker processes — use backend='cluster-oracle' "
             "here, or run_chaos_soak for the out-of-process fleet")

@@ -178,7 +178,7 @@ class ReplicaKiller:
     it before the sweep starts.
     """
 
-    KILL_MODES = ("auto", "wedge", "sigkill")
+    KILL_MODES = ("auto", "wedge", "sigkill", "partition", "halfopen")
     site = inject.SITE_REPLICA
 
     def __init__(self, plan: FaultPlan, router=None, mode: str = "auto"):
@@ -190,13 +190,14 @@ class ReplicaKiller:
         self.mode = mode
         self.kills: List[int] = []
 
-    def _kill(self, victim: int) -> None:
-        """Deliver the kill per ``self.mode`` (victim already chosen,
-        last-alive policy already applied in ``checkpoint``)."""
+    def _kill(self, victim: int, mode: Optional[str] = None) -> None:
+        """Deliver the kill per ``mode`` (defaults to ``self.mode``;
+        victim already chosen, last-alive policy already applied in
+        ``checkpoint``)."""
         replica = self.router.replicas[victim]
         is_proc = hasattr(replica, "kill_process")
         health = getattr(self.router, "health", None)
-        mode = self.mode
+        mode = self.mode if mode is None else mode
         if mode == "auto":
             if is_proc:
                 raise ValueError(
@@ -215,6 +216,23 @@ class ReplicaKiller:
                     f"wedge on replica {victim} (attach_health, or use "
                     f"mode='auto' for direct fail_replica)")
             replica.wedge()
+        elif mode in ("partition", "halfopen"):
+            # link fault, not a kill: sever the victim's REAL socket in
+            # one ("halfopen") or both ("partition") directions — the
+            # router's relink path must heal the SAME incarnation
+            if not getattr(replica, "supports_relink", False):
+                raise ValueError(
+                    f"ReplicaKiller(mode={mode!r}) refuses replica "
+                    f"{victim}: partitioning needs a socket-transport "
+                    f"ProcReplica (transport='socket') — a pipe/in-"
+                    f"process replica has no network link to cut")
+            if health is None:
+                raise ValueError(
+                    f"ReplicaKiller(mode={mode!r}) without an attached "
+                    f"HealthWatchdog/relink supervisor: nothing would "
+                    f"ever heal the partitioned link on replica {victim} "
+                    f"(attach_health first)")
+            replica.partition_link(halfopen=(mode == "halfopen"))
         elif mode == "sigkill":
             if not is_proc:
                 raise ValueError(
@@ -236,15 +254,24 @@ class ReplicaKiller:
         fault = self.plan.poll(self.site)
         if fault is None or self.router is None:
             return None
-        if fault.kind != "crash":
-            log.warning("replica fault %r ignored: only 'crash' is "
-                        "meaningful at %s", fault.kind, self.site)
+        if fault.kind in ("partition", "halfopen"):
+            # a LINK fault, not a kill: mode rides the fault kind, and
+            # the last-alive kill policy does not apply — a partitioned
+            # link heals by relink (same incarnation), which _kill's
+            # watchdog requirement guarantees is supervised
+            mode = fault.kind
+        elif fault.kind == "crash":
+            mode = None               # _kill resolves self.mode
+        else:
+            log.warning("replica fault %r ignored: only 'crash'/"
+                        "'partition'/'halfopen' are meaningful at %s",
+                        fault.kind, self.site)
             return None
         alive = self.router.alive_ids()
         sup = getattr(self.router, "supervisor", None)
         restart_on = sup is not None and getattr(sup, "restart_enabled",
                                                  False)
-        if len(alive) <= 1 and not restart_on:
+        if mode is None and len(alive) <= 1 and not restart_on:
             if self.mode == "sigkill":
                 raise ValueError(
                     f"refusing SIGKILL: {len(alive)} replica(s) alive "
@@ -256,7 +283,7 @@ class ReplicaKiller:
                         "no restart-enabled supervisor", len(alive))
             return None
         victim = alive[fault.index % len(alive)]
-        self._kill(victim)
+        self._kill(victim, mode)
         self.kills.append(victim)
         METRICS.inc("faults.replica_kills")
         log.warning("replica kill #%d: replica %d killed (%d alive)",
@@ -276,3 +303,21 @@ class ProcKiller(ReplicaKiller):
 
     def __init__(self, plan: FaultPlan, router=None):
         super().__init__(plan, router, mode="sigkill")
+
+
+class NetKiller(ReplicaKiller):
+    """ReplicaKiller specialized for LINK faults on a socket-transport
+    fleet: polls ``inject.SITE_NET`` on its own plan and severs the
+    victim's REAL loopback socket — ``partition`` (both directions) or
+    ``halfopen`` (receive direction only), per the scheduled fault kind
+    (``mode`` is the default for plain "crash" draws, which a SITE_NET
+    plan normally never schedules).  The worker process stays alive and
+    warm; healing MUST be a relink (same incarnation, fresh session
+    nonce) — ``_kill`` refuses victims without ``supports_relink`` or a
+    bound watchdog, so a partition can never become a silent outage."""
+
+    site = inject.SITE_NET
+
+    def __init__(self, plan: FaultPlan, router=None,
+                 mode: str = "partition"):
+        super().__init__(plan, router, mode=mode)

@@ -348,6 +348,28 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
                       f'incarnation="{stats["incarnation"]}"}}')
             fam_alive.add(stats["alive"], labels=labels)
             fam_rpc.add(stats["rpcs"], labels=labels)
+        # socket-transport links (cluster/net.py): the session nonce is
+        # a label so every relink is visible as a label change, the
+        # value is link aliveness — 0 with cluster_proc_alive still 1
+        # is the "link death, not process death" signature
+        fam_link = None
+        for rid in sorted(router.replicas):
+            link_fn = getattr(router.replicas[rid].backend, "link_stats",
+                              None)
+            if link_fn is None:
+                continue
+            link = link_fn()
+            if link is None:      # pipe transport: no link to report
+                continue
+            if fam_link is None:
+                fam_link = family(
+                    f"{_PREFIX}cluster_link_alive", "gauge",
+                    "socket link liveness per out-of-process replica "
+                    "(1=connected 0=partitioned; nonce label bumps on "
+                    "every relink)")
+            fam_link.add(1 if link["alive"] else 0,
+                         labels=(f'{{replica="{rid}",'
+                                 f'nonce="{link["nonce"]}"}}'))
         health = getattr(router, "health", None)
         if health is not None:
             # watchdog verdict per replica, numerically encoded so the

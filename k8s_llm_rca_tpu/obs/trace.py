@@ -97,6 +97,12 @@ SITES = frozenset({
     "cluster.proc.spawn",
     "cluster.proc.rpc",
     "cluster.proc.exit",
+    # cross-host links (cluster/proc.py socket transport): a link going
+    # down with the process still alive (evidence, not a death verdict)
+    # and the relink that heals the SAME incarnation under a fresh
+    # session nonce
+    "cluster.net.partition",
+    "cluster.net.relink",
     # graph layer
     "graph.query",
     # rca pipeline stages

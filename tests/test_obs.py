@@ -623,6 +623,27 @@ class TestSiteCoverage:
         assert {"cluster.proc.spawn", "cluster.proc.rpc",
                 "cluster.proc.exit"} <= tr_proc.emitted_names()
 
+        # (10) cross-host link sites: sever ONE socket worker's link
+        # (process stays alive) and relink it under a fresh nonce — the
+        # link-evidence event and the relink span both fire
+        # (cluster/proc.py: link death =/= process death)
+        from k8s_llm_rca_tpu.cluster.wire import WireError
+
+        tr_net = Tracer(clock=VirtualClock())
+        tracers.append(tr_net)
+        with obs_trace.tracing(tr_net):
+            (net_replica,) = build_proc_replicas(1, kind="oracle",
+                                                 transport="socket")
+            try:
+                net_replica.partition_link()
+                with pytest.raises(WireError):
+                    net_replica.backend._rpc("ping", probe=0)
+                assert net_replica.backend.relink()
+            finally:
+                net_replica.close()
+        assert {"cluster.net.partition", "cluster.net.relink"} \
+            <= tr_net.emitted_names()
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:
