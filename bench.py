@@ -1101,6 +1101,118 @@ def bench_net_cluster(n_pings: int = 30, n_runs: int = 8):
             "partitions_healed": relinks}
 
 
+def bench_disagg(n_runs: int = 6):
+    """Disaggregated prefill/decode leg (cluster/disagg.py): one TINY
+    engine worker per tier, fresh interpreter, measurement-or-null.
+
+    Trust argument (same as ``bench_proc_cluster``): engine workers are
+    single-device CPU subprocesses (``JAX_PLATFORMS=cpu``), so every
+    number here is local process/RPC/numpy wall-clock the tunnel's
+    memoizer and ~0.25 s dispatch latency cannot touch; prompts are
+    distinct per run so no dispatch repeats anywhere.
+
+    - ``disagg_handoff_ms_per_page``: summed EXPORT+ADOPT rpc wall-clock
+      over summed pages moved, hand-timed per transfer on the raw seam
+      (the successful ``export_run`` call and its ``adopt_run``; page
+      counts decoded from each frame's own CRC-framed page record).
+    - ``disagg_ttft_p50_s``: p50 wall-clock from admission on the
+      prefill tier to a settled ``max_new_tokens=1`` result through the
+      TierRouter — admission, prefill, cross-tier handoff, first decoded
+      token (post-warmup, distinct prompts).
+    - ``disagg_handoffs_retried``: exact router count of transfers
+      discarded whole and re-attempted during the TTFT phase (expected
+      0 on a healthy fleet; count-exact, not a timing).
+    """
+    import base64
+    import time
+
+    from k8s_llm_rca_tpu.cluster import TierRouter
+    from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
+    from k8s_llm_rca_tpu.serve.backend import GenOptions
+    from k8s_llm_rca_tpu.utils import pages as pages_mod
+
+    # decode_chunk=1: the seam phase must catch runs MID-decode (a
+    # 16-token chunk commits all 8 bench tokens in one pump and leaves
+    # no export window); byte-parity-guaranteed knob, both tiers agree
+    replicas = build_proc_replicas(
+        2, kind="engine", seed=0,
+        engine_overrides={"decode_chunk": 1})
+    try:
+        router = TierRouter([replicas[0]], [replicas[1]])
+
+        def run_once(prompt, max_new):
+            h = router.start(prompt, GenOptions(max_new_tokens=max_new))
+            out = {}
+            for _ in range(512):
+                out.update(router.pump())
+                if h in out:
+                    return out[h]
+            return None
+
+        # warmup: compiles the prefill bucket on the prefill worker and
+        # the decode step on the decode worker (excluded from timing)
+        warm = run_once("disagg bench warmup", 8)
+        ok = warm is not None and warm.error is None
+
+        ttfts = []
+        for i in range(n_runs):
+            t0 = time.perf_counter()
+            res = run_once(f"disagg bench ttft run {i}", 1)
+            ttfts.append(time.perf_counter() - t0)
+            ok = ok and res is not None and res.error is None
+        ok = ok and router.handoffs == n_runs + 1
+        ttfts.sort()
+        ttft_p50_s = (round(ttfts[len(ttfts) // 2], 4)
+                      if ok and ttfts else None)
+        retried = router.handoffs_retried if ok else None
+
+        # raw-seam transfer cost on the (warm) workers: time ONLY the
+        # successful export rpc and its adopt rpc, count pages from the
+        # frame's own page record
+        src, dst = replicas[0].backend, replicas[1].backend
+        xfer_s, n_pages = 0.0, 0
+        seam_ok = True
+        for i in range(n_runs):
+            opts = GenOptions(max_new_tokens=8)
+            h = src.start(f"disagg bench seam run {i}", opts)
+            frame = None
+            for _ in range(64):
+                if h in src.pump():
+                    break
+                t0 = time.perf_counter()
+                frame = src.export_run(h)
+                t1 = time.perf_counter()
+                if frame is not None:
+                    break
+            if frame is None or frame.get("kv") is None:
+                seam_ok = False
+                src.cancel(h)
+                continue
+            rec = pages_mod.decode_page_record(
+                base64.b64decode(frame["kv"]["b64"]))
+            t2 = time.perf_counter()
+            h2 = dst.adopt_run(frame, opts)
+            t3 = time.perf_counter()
+            xfer_s += (t1 - t0) + (t3 - t2)
+            n_pages += int(rec["n_pages"]) if rec else 0
+            src.cancel(h)
+            out = {}
+            for _ in range(128):
+                out.update(dst.pump())
+                if h2 in out:
+                    break
+            seam_ok = (seam_ok and h2 in out
+                       and out[h2].error is None)
+        handoff_ms_per_page = (round(xfer_s * 1000.0 / n_pages, 4)
+                               if seam_ok and n_pages else None)
+    finally:
+        for r in replicas:
+            r.close()
+    return {"handoff_ms_per_page": handoff_ms_per_page,
+            "ttft_p50_s": ttft_p50_s,
+            "handoffs_retried": retried}
+
+
 def bench_host_overlap(n_prompts: int = 48, max_batch: int = 8,
                        prompt_len: int = 64, max_new: int = 32):
     """Overlapped-hot-loop leg (docs/performance.md): the TINY paged
@@ -1386,6 +1498,7 @@ def main():
     prefix_tiers = _leg("bench.bench_prefix_leg()", timeout=1500) or {}
     proc_cluster = _leg("bench.bench_proc_cluster()", timeout=1500) or {}
     net_cluster = _leg("bench.bench_net_cluster()", timeout=1500) or {}
+    disagg = _leg("bench.bench_disagg()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -1596,6 +1709,14 @@ def main():
             "rpc_roundtrip_p50_ms"),
         "net_relink_recovery_s": net_cluster.get("relink_recovery_s"),
         "net_partitions_healed": net_cluster.get("partitions_healed"),
+        # disaggregated prefill/decode tiers (cluster/disagg.py): engine
+        # workers on local pipes — per-page EXPORT+ADOPT transfer cost
+        # on the raw seam, admission-to-first-token p50 through the
+        # TierRouter, and the exact retried-transfer count; null when
+        # the leg failed — schema stays stable
+        "disagg_handoff_ms_per_page": disagg.get("handoff_ms_per_page"),
+        "disagg_ttft_p50_s": disagg.get("ttft_p50_s"),
+        "disagg_handoffs_retried": disagg.get("handoffs_retried"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

@@ -23,9 +23,15 @@ Layer map (PARITY.md §cluster, docs/cluster.md):
   bench.py per-leg env recipe) behind the length-prefixed CRC-framed
   wire protocol (``wire.py``); the watchdog's liveness verdicts gain
   hard OS evidence (pipe EOF / exit codes) and the supervisor's
-  ``rebuild`` restarts the actual process.
+  ``rebuild`` restarts the actual process;
+- ``disagg.TierRouter`` — disaggregated prefill/decode tiers over any
+  of the above replica shapes, with a transactional (EXPORT -> ADOPT ->
+  RELEASE) per-run KV handoff between the tiers that survives
+  mid-handoff kills (``faults.supervisor.HandoffKiller``).
 """
 
+from k8s_llm_rca_tpu.cluster.disagg import (TIER_DECODE, TIER_PREFILL,
+                                            TierRouter)
 from k8s_llm_rca_tpu.cluster.health import (ALIVE, DEAD, SUSPECT,
                                             HealthPolicy, HealthWatchdog,
                                             ReplicaSupervisor)
@@ -42,4 +48,5 @@ __all__ = [
     "HealthPolicy", "HealthWatchdog", "ReplicaSupervisor",
     "ALIVE", "SUSPECT", "DEAD",
     "ProcReplica", "build_proc_replicas",
+    "TierRouter", "TIER_PREFILL", "TIER_DECODE",
 ]

@@ -254,6 +254,17 @@ def _handle_op(msg: Dict[str, Any], backend, state: Dict[str, int],
             opts = [decode_gen(g) for g in msg["gens"]]
             reply["handles"] = backend.adopt_sequences(msg["snap"],
                                                        opts)
+        elif op == "export_run":
+            # per-run handoff EXPORT (cluster/disagg.py); None frame =
+            # not exportable this pump (settled / mid-prefill) — the
+            # caller treats that as try-again, not failure
+            reply["frame"] = backend.export_run(int(msg["handle"]))
+        elif op == "adopt_run":
+            # per-run handoff ADOPT: a torn frame raises inside
+            # adopt_run and crosses the wire as err (WorkerError
+            # parent-side) BEFORE any engine state moved
+            reply["handle"] = backend.adopt_run(msg["frame"],
+                                                decode_gen(msg["gen"]))
         elif op == "drain":
             # graceful shutdown: finish nothing, ack, exit 0 — the
             # parent has already migrated/cancelled what it wanted
@@ -631,9 +642,13 @@ class ProcBackend:
 
             self._tokenizer = get_tokenizer(vocab_size=TINY.vocab_size)
             # drain/adopt seam, bound per-kind so ``hasattr`` keeps the
-            # router's scripted-replica drain refusal intact
+            # router's scripted-replica drain refusal intact; the
+            # per-run handoff seam (cluster/disagg.py) follows the same
+            # pattern — TierRouter detects it with hasattr too
             self.snapshot_sequences = self._snapshot_sequences
             self.adopt_sequences = self._adopt_sequences
+            self.export_run = self._export_run
+            self.adopt_run = self._adopt_run
         else:
             self._tokenizer = get_tokenizer()
         t0 = time.perf_counter()
@@ -1076,6 +1091,29 @@ class ProcBackend:
         for h in handles:
             self._live[h] = True
         return handles
+
+    def _export_run(self, handle: int) -> Optional[Dict[str, Any]]:
+        """Per-run EXPORT over the wire (cluster/disagg.py).  A handle
+        that is parent-local (injected fault) or no longer live exports
+        as None — the run settled between pumps, which is a self-clean
+        for the handoff queue, never a retry."""
+        if handle < 0 or not self._live.get(handle, False):
+            return None
+        resp = self._rpc("export_run", handle=handle)
+        return resp.get("frame")
+
+    def _adopt_run(self, frame: Dict[str, Any], opts: Any) -> int:
+        """Per-run ADOPT over the wire: the worker validates the whole
+        frame before touching engine state; a torn frame surfaces here
+        as WorkerError(ValueError) with nothing adopted.  The reply
+        rides the incarnation(+nonce) fence like every RPC — a late ack
+        from a dead incarnation can never register a handle."""
+        from k8s_llm_rca_tpu.serve.journal import encode_gen
+
+        resp = self._rpc("adopt_run", frame=frame, gen=encode_gen(opts))
+        handle = int(resp["handle"])
+        self._live[handle] = True
+        return handle
 
     # ------------------------------------------------------------ lifecycle
 

@@ -251,15 +251,24 @@ class ClusterRouter:
         return replica.queue_depth() < cap
 
     def _pick(self, session: str, admit: bool = True,
-              priority: int = 1) -> int:
+              priority: int = 1,
+              among: Optional[List[int]] = None) -> int:
         """Deterministic replica choice; raises RouterAdmissionError when
         the cluster is saturated for the request's priority class.
         ``admit=False`` is the failover path: the run was ALREADY
         admitted, so the inflight cap does not apply — a kill must never
-        shed work the cluster accepted."""
-        alive = self.alive_ids()
-        if not alive:
+        shed work the cluster accepted.  ``among`` narrows the candidate
+        set (the TierRouter's tier filter, cluster/disagg.py); when no
+        candidate is alive the filter is DROPPED rather than refusing —
+        a whole dead tier degrades to keep-serving, not an outage."""
+        full_alive = self.alive_ids()
+        if not full_alive:
             raise RouterAdmissionError("no alive replica")
+        alive = full_alive
+        if among is not None:
+            tiered = [rid for rid in full_alive if rid in among]
+            if tiered:
+                alive = tiered
         # route around SUSPECT replicas (cluster/health.py) while any
         # fully-ALIVE replica exists — new work must not pile onto a
         # replica the watchdog already distrusts; if EVERY replica is
@@ -271,6 +280,11 @@ class ClusterRouter:
             pinned = self._affinity.get(session)
             if pinned is not None and not self.replicas[pinned].alive:
                 pinned = None               # re-pin below
+            if pinned is not None and pinned not in alive:
+                # alive but outside this pick's candidate tier: ignore
+                # the pin for THIS pick without deleting it — it stays
+                # valid for future picks over its own tier
+                pinned = None
             if (pinned is not None and pinned in suspect
                     and len(suspect) < len(alive)):
                 del self._affinity[session]   # pin follows to a healthy
@@ -291,7 +305,7 @@ class ClusterRouter:
                 f"{self.max_inflight} for priority {priority}; "
                 "shedding request")
         rid = min(open_, key=lambda r: (self.replicas[r].queue_depth(), r))
-        if session and self._affinity.get(session) not in alive:
+        if session and self._affinity.get(session) not in full_alive:
             self._affinity[session] = rid   # (re-)pin; overflow keeps pin
         return rid
 

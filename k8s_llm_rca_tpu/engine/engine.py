@@ -811,6 +811,63 @@ class EngineBase:
             self._key = jnp.asarray(key, dtype=jnp.uint32)
         return restored
 
+    # ------------------------------------------- per-run export / adopt
+
+    def _export_entry(self, req: "_Pending",
+                      resumed: Dict[int, List[int]]) -> Dict[str, object]:
+        """One pending sequence as a ``snapshot_sequences``-shaped entry
+        (the handoff frame's durable half, cluster/disagg.py)."""
+        return {
+            "seq_id": req.seq_id,
+            "prompt_ids": list(self._prompts.get(req.seq_id,
+                                                 req.prompt_ids)),
+            "generated": list(resumed.get(req.seq_id, ())),
+            "remaining_new_tokens": req.max_new_tokens,
+            "stop_strings": list(req.stop_strings),
+            "grammar": req.grammar is not None,
+            "priority": req.priority,
+            "deadline": (self._deadlines or {}).get(req.seq_id),
+        }
+
+    def export_run(self, seq_id: int
+                   ) -> Optional[Tuple[Dict[str, object],
+                                       Optional[Dict[str, object]]]]:
+        """Per-run EXPORT half of the disaggregated handoff: freeze ONE
+        sequence and return ``(entry, kv_record)`` — the snapshot-shaped
+        token entry plus (paged engine only) the host page record of its
+        computed KV.  The sequence STAYS live here, pinned in the pending
+        queue with its spill record, until the adopter acks and the
+        caller cancels it (RELEASE) — so a death anywhere mid-handoff
+        leaves a re-runnable source, never a torn sequence.
+
+        Returns None when the run is not exportable THIS pump (base
+        engine: actively decoding — it will settle here instead; paged:
+        mid-chunked-prefill or holding uncommitted first tokens).  A
+        settled/unknown seq_id raises.
+        """
+        self._overlap_barrier()
+        resumed = getattr(self, "_resumed", None)
+        for req in self._pending:
+            if req.seq_id == seq_id:
+                return self._export_entry(req, resumed or {}), None
+        for st in self._active.values():
+            if st.seq_id == seq_id:
+                # the base engine cannot preempt mid-decode; let the run
+                # settle locally — the handoff queue self-cleans
+                return None
+        raise ValueError(f"export_run: seq {seq_id} is not live")
+
+    def adopt_run(self, entry: Dict[str, object], kv=None,
+                  grammar=None) -> int:
+        """Per-run ADOPT half: re-admit ONE exported entry (optionally
+        with its KV page record — ignored on the base engine, which
+        re-prefills byte-identically).  Returns the seq_id adopted."""
+        sid = int(entry["seq_id"])
+        self.restore_sequences(
+            {"rng_key": None, "sequences": [entry]},
+            grammars={sid: grammar} if grammar is not None else None)
+        return sid
+
     # -------------------------------------------------- fault injection
 
     FAULT_SITE = inject.SITE_ENGINE_TICK

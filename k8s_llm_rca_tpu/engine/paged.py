@@ -59,8 +59,9 @@ from k8s_llm_rca_tpu.ops.rope import rope_frequencies
 from k8s_llm_rca_tpu.runtime import profiling
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.pages import (
-    gather_pages, record_nbytes, records_compatible, restore_pages,
-    split_pages, stack_pages, suffix_bucket,
+    gather_pages, pool_compatible, record_fields, record_nbytes,
+    records_compatible, restore_pages, split_pages, stack_pages,
+    suffix_bucket,
 )
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
@@ -2569,9 +2570,11 @@ class PagedInferenceEngine(EngineBase):
         if private:
             self.allocator.free(private, owner=st.seq_id)
 
-    def _preempt_slot(self, slot: int, spill: bool = True) -> None:
+    def _preempt_slot(self, slot: int, spill: bool = True,
+                      budget_exempt: bool = False) -> None:
         st = self._active.pop(slot)
-        spilled = spill and self._maybe_spill(slot, st)
+        spilled = spill and self._maybe_spill(slot, st,
+                                              budget_exempt=budget_exempt)
         if not spilled:
             self._release_slot_pages(slot, st)
         self.block_tables[slot] = TRASH_PAGE
@@ -2650,7 +2653,8 @@ class PagedInferenceEngine(EngineBase):
         self._overlap_barrier()
         return self.prefix_cache.flush_to_store(limit)
 
-    def _maybe_spill(self, slot: int, st: _Active) -> bool:
+    def _maybe_spill(self, slot: int, st: _Active,
+                     budget_exempt: bool = False) -> bool:
         """Spill a preempted slot's written private KV pages to host
         buffers (ONE coalesced d2h gather) so the sequence later resumes
         by h2d page restore instead of re-prefill.  Returns False — and
@@ -2664,7 +2668,11 @@ class PagedInferenceEngine(EngineBase):
         (the record holds host copies), the shared prefix pages KEEP their
         prefix-cache refcounts (held by the record, transferred back to
         the slot at restore) so they cannot be evicted while spilled."""
-        if not self.engine_cfg.max_spilled_pages:
+        # budget_exempt (export_run, cluster/disagg.py): the gathered
+        # pages leave for another replica as soon as the adopter acks —
+        # charging them against max_spilled_pages (or requiring the
+        # feature on) would couple handoff capacity to local spill policy
+        if not budget_exempt and not self.engine_cfg.max_spilled_pages:
             return False
         prefix = self._resumed.get(st.seq_id, []) + st.generated
         if not prefix:
@@ -2684,7 +2692,8 @@ class PagedInferenceEngine(EngineBase):
         spill_idx = [int(p) for p in table[st.n_shared:n_written]]
         if any(p == TRASH_PAGE for p in spill_idx):
             return False
-        if (self._spilled_pages_total + len(spill_idx)
+        if (not budget_exempt
+                and self._spilled_pages_total + len(spill_idx)
                 > self.engine_cfg.max_spilled_pages):
             self._count("engine.spill_budget_fallbacks")
             return False
@@ -2768,6 +2777,100 @@ class PagedInferenceEngine(EngineBase):
         self._spilled_pages_total -= int(rec["n_pages"])
         if rec["shared_pages"] and self.prefix_cache is not None:
             self.prefix_cache.release(rec["shared_pages"])
+
+    # ------------------------------------------- per-run export / adopt
+
+    def export_run(self, seq_id: int
+                   ) -> Optional[Tuple[Dict[str, object],
+                                       Optional[Dict[str, object]]]]:
+        """Paged EXPORT: an actively-decoding run is frozen via the
+        preemption path (``_preempt_slot`` with the spill budget waived —
+        the pages are leaving, not parking) so the returned kv record
+        carries its computed KV; a still-queued run exports entry-only
+        (the adopter re-prefills byte-identically).  The sequence stays
+        pinned in the pending queue WITH its spill record until the
+        caller cancels it (RELEASE) — export is idempotent across retry
+        attempts.  None = not exportable this pump (mid-chunked-prefill,
+        or a deferred first token not yet committed)."""
+        self._overlap_barrier()
+        for pst in self._prefilling.values():
+            if pst["req"].seq_id == seq_id:
+                return None
+        for slot, st in list(self._active.items()):
+            if st.seq_id == seq_id:
+                prefix = self._resumed.get(seq_id, []) + st.generated
+                length = int(self.lengths[slot])
+                if (not prefix or length + 1
+                        != st.prompt_tokens + len(st.generated)):
+                    # nothing generated yet / deferred first token not
+                    # committed — the next tick commits; retry then
+                    return None
+                self._preempt_slot(slot, spill=True, budget_exempt=True)
+                break
+        for req in self._pending:
+            if req.seq_id == seq_id:
+                return (self._export_entry(req, self._resumed),
+                        self._transfer_record(seq_id))
+        raise ValueError(f"export_run: seq {seq_id} is not live")
+
+    def _transfer_record(self, seq_id: int
+                         ) -> Optional[Dict[str, object]]:
+        """The host-safe page record a handoff frame ships: the spill
+        record's private pages plus a READ-ONLY gather of its shared
+        prefix pages, flattened to one self-contained run (n_shared=0 on
+        the wire — the adopter owns every page it restores; its own
+        prefix cache re-shares on later runs).  The local record and its
+        prefix refcounts are untouched: RELEASE (cancel_seq →
+        ``_drop_spill``) frees them only after the adopter acks."""
+        rec = self._spilled.get(seq_id)
+        if rec is None:
+            return None
+        parts: List[Dict[str, object]] = []
+        shared = [int(p) for p in rec["shared_pages"]]
+        if shared:
+            parts.append(gather_pages(self.pool, self._fetch, shared))
+        n_priv = int(rec["n_pages"])
+        if n_priv:
+            part: Dict[str, object] = {"n_pages": n_priv}
+            for f in record_fields(rec):
+                part[f] = rec[f]
+            parts.append(part)
+        if not parts:
+            return None
+        out = dict(parts[0]) if len(parts) == 1 else stack_pages(parts)
+        out["n_shared"] = 0
+        out["shared_pages"] = []
+        out["length"] = int(rec["length"])
+        out["cur_token"] = int(rec["cur_token"])
+        return out
+
+    def adopt_run(self, entry: Dict[str, object], kv=None,
+                  grammar=None) -> int:
+        """Paged ADOPT: re-admit the entry, then stage the transferred
+        KV record as a local spill so ``_admit_spilled`` resumes it by
+        h2d restore at the exact preemption state.  EVERY validation
+        runs before any allocator/slot state moves; a record that fails
+        (wrong pool layout, length mismatch) is dropped whole and the
+        run re-prefills — same tokens, never a half-adopted sequence."""
+        sid = super().adopt_run(entry, kv=None, grammar=grammar)
+        if kv is None:
+            return sid
+        resume_len = (len(entry["prompt_ids"])
+                      + len(entry["generated"]))
+        n = int(kv.get("n_pages", 0))
+        ok = (int(kv.get("n_shared", 1)) == 0
+              and not kv.get("shared_pages")
+              and n >= 1
+              and int(kv.get("length", -1)) + 1 == resume_len
+              and n <= self.pages_per_seq
+              and pool_compatible(self.pool, kv))
+        if not ok:
+            self._count("engine.handoff_kv_rejected")
+            return sid
+        self._spilled[sid] = kv
+        self._spilled_pages_total += n
+        self._count("engine.handoff_kv_adopted")
+        return sid
 
     def _expire_extra(self, seq_id: int) -> Optional[SequenceResult]:
         """Deadline-reap a mid-chunked-prefill sequence: build its result

@@ -45,7 +45,16 @@ Sites in the real stack:
   relink path must heal the SAME incarnation under a fresh session
   nonce), and the full netem vocabulary (delay/trickle/duplicate/
   corrupt/heal) when a ``NetemTransport`` wraps the link.  Own-plan
-  discipline again: link faults never touch the armed plan's counters.
+  discipline again: link faults never touch the armed plan's counters;
+- ``SITE_HANDOFF`` (``cluster/disagg.py::TierRouter`` +
+  ``faults/supervisor.py::HandoffKiller``): faults on the per-run KV
+  handoff between the prefill and decode tiers — "drop" (EXPORT frame
+  lost), "corrupt" (frame torn in flight; the adopter discards it
+  whole), "delay" (virtual-clock transfer latency), "stale-fence" (the
+  ADOPT ack loses the fencing race and the adopted twin is cancelled),
+  plus the killer's crash/partition/halfopen landing exactly between
+  EXPORT and ADOPT.  Own-plan discipline: polled once per transfer
+  attempt from the handoff plan, never from the armed chaos plan.
 """
 
 from __future__ import annotations
@@ -62,6 +71,7 @@ SITE_PROCESS = "serve.process"
 SITE_REPLICA = "cluster.replica"
 SITE_PROC = "cluster.proc"
 SITE_NET = "cluster.net"
+SITE_HANDOFF = "cluster.handoff"
 
 # the armed plan; hot paths read this directly (see module docstring)
 _ARMED: Optional[FaultPlan] = None

@@ -34,11 +34,13 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 # partition (both directions die), halfopen (one direction), delay,
 # trickle (byte-at-a-time), duplicate (frame delivered twice), corrupt
 # (bit-flipped frame), and heal (clear any sticky link fault) —
-# faults/netem.py)
+# faults/netem.py; the prefill->decode KV handoff at SITE_HANDOFF:
+# drop (EXPORT frame lost), corrupt/delay (shared kinds), and
+# stale-fence (ADOPT ack loses the fencing race) — cluster/disagg.py)
 FAULT_KINDS = ("error", "timeout", "slow", "poison", "empty",
                "budget", "stall", "oom", "preempt", "crash",
                "partition", "halfopen", "delay", "trickle",
-               "duplicate", "corrupt", "heal")
+               "duplicate", "corrupt", "heal", "drop", "stale-fence")
 
 
 @dataclass(frozen=True)

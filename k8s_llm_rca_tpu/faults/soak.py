@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.faults.plan import FaultPlan, VirtualClock
@@ -110,7 +110,9 @@ def _build_oracle_service(run_timeout_s: float, clock, journal=None):
 def _build_cluster_service(run_timeout_s: float, clock, journal=None,
                            n_replicas: int = 2, oracle: bool = False,
                            selfheal: bool = False, health_policy=None,
-                           proc: bool = False, transport: str = "pipe"):
+                           proc: bool = False, transport: str = "pipe",
+                           tier_split: Optional[Tuple[int, int]] = None,
+                           handoff_plan=None):
     """N-replica serving behind a ClusterRouter (cluster/).  ``oracle``
     replicas are scripted backends — the cheap mode the 100-incident
     replica-kill soak runs on (tier-1 budget); engine replicas reuse the
@@ -133,6 +135,13 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
     HealthWatchdog on the soak's VirtualClock plus a restart-enabled
     ReplicaSupervisor, so wedged replicas are detected, failed over and
     rejoined in-tree with no external ``fail_replica`` call.
+
+    ``tier_split``: ``(n_prefill, n_decode)`` — split the fleet into
+    disaggregated prefill/decode tiers behind a TierRouter
+    (cluster/disagg.py); every run admits on the prefill tier and its
+    KV (for scripted workers: its placement) moves to a decode replica
+    through the transactional EXPORT -> ADOPT -> RELEASE handoff.
+    ``handoff_plan``: the TierRouter's own SITE_HANDOFF FaultPlan.
 
     Returns ``(service, engines, factory, router)`` — ``engines`` is the
     per-replica engine list ([] for oracle replicas) so the caller can
@@ -175,7 +184,19 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
             # virtual-clock deadlines even without an armed plan (see
             # _build_engine_service)
             eng.clock = clock
-    router = ClusterRouter(replicas)
+    if tier_split is not None:
+        from k8s_llm_rca_tpu.cluster import TierRouter
+
+        n_prefill, n_decode = int(tier_split[0]), int(tier_split[1])
+        if n_prefill + n_decode != n_replicas:
+            raise ValueError(
+                f"tier_split {tier_split} must sum to the fleet size "
+                f"({n_replicas}): tiers partition the SAME replicas, "
+                f"they do not add capacity")
+        router = TierRouter(replicas[:n_prefill], replicas[n_prefill:],
+                            handoff_plan=handoff_plan)
+    else:
+        router = ClusterRouter(replicas)
     if selfheal:
         from k8s_llm_rca_tpu.cluster import (
             HealthWatchdog, ReplicaSupervisor,
@@ -235,7 +256,10 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    cluster_replicas: int = 2,
                    killer: Optional[Any] = None,
                    selfheal: bool = False,
-                   concurrency: int = 1) -> Dict[str, Any]:
+                   concurrency: int = 1,
+                   tier_split: Optional[Tuple[int, int]] = None,
+                   handoff_plan: Optional[FaultPlan] = None
+                   ) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
 
@@ -247,18 +271,28 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     ClusterRouter (cluster/router.py).  "proc-cluster" runs the oracle
     replicas out-of-process over stdio pipes (cluster/proc.py);
     "net-cluster" runs them over loopback TCP sockets (cluster/net.py),
-    the fleet a NetKiller can partition and the router relinks — both
-    report as "cluster-oracle" (byte-identity is the acceptance bar).
+    the fleet a NetKiller can partition and the router relinks;
+    "disagg-cluster" splits the proc-oracle fleet into disaggregated
+    prefill/decode tiers behind a TierRouter (cluster/disagg.py,
+    ``tier_split`` — default splits the fleet in half, prefill-heavy) —
+    all three report as "cluster-oracle" (byte-identity is the
+    acceptance bar; tiers and transports are deployment detail).
 
-    ``killer``: optional faults.supervisor.ReplicaKiller (cluster modes
-    only) polled once at every incident boundary on its OWN FaultPlan;
-    on a scheduled "crash" one replica dies and the router fails its
-    work over to survivors.  Like the supervisor, kill stats live on
-    the killer object, never in the report — the kill-soak report must
-    stay byte-identical to the unkilled run's (use a plan_spec without
-    SITE_ENGINE_TICK for engine clusters: per-tick polls shift with the
-    survivor's extra ticks, which is fault-schedule divergence, not
-    nondeterminism).
+    ``killer``: optional faults.supervisor.ReplicaKiller — or a LIST of
+    killers with pairwise-disjoint fault sites (e.g. a ProcKiller, a
+    NetKiller and a HandoffKiller side by side; two killers on one site
+    would double-count its plan per incident, a loud ValueError) —
+    cluster modes only, each polled once at every incident boundary on
+    its OWN FaultPlan; on a scheduled "crash" one replica dies and the
+    router fails its work over to survivors.  A HandoffKiller
+    (``backend="disagg-cluster"`` only) is instead bound to the
+    TierRouter and fires inside the EXPORT -> ADOPT window of KV
+    handoffs, never at boundaries.  Like the supervisor, kill stats
+    live on the killer objects, never in the report — the kill-soak
+    report must stay byte-identical to the unkilled run's (use a
+    plan_spec without SITE_ENGINE_TICK for engine clusters: per-tick
+    polls shift with the survivor's extra ticks, which is
+    fault-schedule divergence, not nondeterminism).
 
     ``tracer``: optional obs.Tracer — activated for the whole soak with
     its clock REBOUND to the soak's VirtualClock, so every span/event
@@ -350,20 +384,69 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                          "journal is the only recovery source a crash "
                          "leaves behind")
 
+    if tier_split is not None and backend != "disagg-cluster":
+        raise ValueError(
+            f"tier_split only applies to backend='disagg-cluster' "
+            f"(got backend={backend!r}): only a TierRouter has tiers "
+            f"to split the fleet into")
+    if handoff_plan is not None and backend != "disagg-cluster":
+        raise ValueError(
+            f"handoff_plan only applies to backend='disagg-cluster' "
+            f"(got backend={backend!r}): SITE_HANDOFF is only polled "
+            f"inside a TierRouter's transfer attempts")
+    if backend == "disagg-cluster" and tier_split is None:
+        # prefill-heavy default: the RCA corpus is long-prompt/short-
+        # verdict, so ceil(n/2) exporters feed floor(n/2) adopters
+        n_prefill = max(1, (cluster_replicas + 1) // 2)
+        tier_split = (n_prefill, cluster_replicas - n_prefill)
+
+    # killer-list validation BEFORE any worker spawns: a ValueError here
+    # must not leak subprocesses (_reaping_workers is not entered yet)
+    killers: List[Any] = []
+    if killer is not None:
+        from k8s_llm_rca_tpu.faults.supervisor import HandoffKiller
+
+        killers = (list(killer) if isinstance(killer, (list, tuple))
+                   else [killer])
+        sites = [k.site for k in killers]
+        dup = sorted({s for s in sites if sites.count(s) > 1})
+        if dup:
+            raise ValueError(
+                f"killers must poll pairwise-disjoint fault sites, but "
+                f"{dup} appear on more than one killer: two killers on "
+                f"one site would double-count its plan per incident and "
+                f"the kill schedule could never match a single-killer "
+                f"run")
+        for k in killers:
+            if (isinstance(k, HandoffKiller)
+                    and backend != "disagg-cluster"):
+                raise ValueError(
+                    f"HandoffKiller requires backend='disagg-cluster' "
+                    f"(got {backend!r}): its kill window only opens "
+                    f"between EXPORT and ADOPT of a TierRouter handoff")
+
     router = None
     if backend == "engine":
         service, engine, factory = _build_engine_service(
             run_timeout_s, clock, journal)
         engines = [engine]
     elif backend in ("cluster", "cluster-oracle", "proc-cluster",
-                     "net-cluster"):
+                     "net-cluster", "disagg-cluster"):
         service, engines, factory, router = _build_cluster_service(
             run_timeout_s, clock, journal,
             n_replicas=cluster_replicas,
             oracle=(backend == "cluster-oracle"),
-            proc=(backend in ("proc-cluster", "net-cluster")),
-            transport=("socket" if backend == "net-cluster" else "pipe"),
-            selfheal=selfheal)
+            proc=(backend in ("proc-cluster", "net-cluster",
+                              "disagg-cluster")),
+            # disagg workers sit on sockets so the mixed-fault soak can
+            # point a NetKiller at a tier member (and a HandoffKiller
+            # can partition mid-window) — the report is transport-
+            # invariant either way
+            transport=("socket" if backend in ("net-cluster",
+                                               "disagg-cluster")
+                       else "pipe"),
+            selfheal=selfheal,
+            tier_split=tier_split, handoff_plan=handoff_plan)
         engine = None   # "engine_clean" is per-replica below
     elif selfheal:
         raise ValueError("selfheal requires a cluster backend: the "
@@ -373,11 +456,15 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
         service, engine, factory = _build_oracle_service(
             run_timeout_s, clock, journal)
         engines = []
-    if killer is not None:
+    if killers:
         if router is None:
             raise ValueError("killer requires a cluster backend: replica "
                              "kills need a router to fail over through")
-        killer.router = router
+        from k8s_llm_rca_tpu.faults.supervisor import HandoffKiller
+        for k in killers:
+            k.router = router
+            if isinstance(k, HandoffKiller):
+                router.handoff_killer = k
     meta = ResilientExecutor(InMemoryGraphExecutor(build_metagraph()),
                              policy, dep="graph.meta")
     state = ResilientExecutor(InMemoryGraphExecutor(build_stategraph()),
@@ -421,7 +508,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     incidents: List[Dict[str, Any]] = []
     n_resolved = n_degraded = n_failed = 0
     with inject.armed(plan), obs_ctx, _reaping_workers(
-            router if backend in ("proc-cluster", "net-cluster")
+            router if backend in ("proc-cluster", "net-cluster",
+                                  "disagg-cluster")
             else None):
         if concurrency > 1:
             from k8s_llm_rca_tpu.rca.scheduler import (
@@ -463,8 +551,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                         service = supervisor.checkpoint(
                             pipeline, service, factory, run_timeout_s,
                             clock)
-                    if killer is not None:
-                        killer.checkpoint()
+                    for k in killers:
+                        k.checkpoint()
                     continue
                 row = _incident_row(message, result)
                 if row["status"] == "degraded":
@@ -479,12 +567,13 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                     # inside)
                     service = supervisor.checkpoint(
                         pipeline, service, factory, run_timeout_s, clock)
-                if killer is not None:
-                    # same discipline, replica granularity: exactly one
-                    # poll per incident on both outcome paths (the
-                    # killer's own plan; the router fails the victim over
-                    # in place)
-                    killer.checkpoint()
+                # same discipline, replica granularity: exactly one poll
+                # per incident per killer on both outcome paths (each
+                # killer's own plan; the router fails the victim over in
+                # place).  List order is the caller's — stable, so a
+                # multi-killer schedule is a pure function of the plans
+                for k in killers:
+                    k.checkpoint()
 
         if router is not None and router.health is not None:
             # kill-and-heal drain: a wedge landed at the LAST incident
@@ -512,13 +601,15 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
 
     report = {
         "seed": seed,
-        # proc-cluster AND net-cluster report as cluster-oracle ON
-        # PURPOSE: the workers run the same scripted oracle over a
-        # different transport (pipe or socket), and the acceptance bar
-        # is byte-identity against the in-process run — a transport tag
-        # would be the one engineered difference
+        # proc-cluster, net-cluster AND disagg-cluster report as
+        # cluster-oracle ON PURPOSE: the workers run the same scripted
+        # oracle over a different transport (pipe or socket) or tier
+        # topology, and the acceptance bar is byte-identity against the
+        # in-process run — a transport/tier tag would be the one
+        # engineered difference
         "backend": ("cluster-oracle"
-                    if backend in ("proc-cluster", "net-cluster")
+                    if backend in ("proc-cluster", "net-cluster",
+                                   "disagg-cluster")
                     else backend),
         "n_incidents": n_incidents,
         "completed": n_resolved + n_degraded,
@@ -647,12 +738,14 @@ def run_pipelined_sweep(seed: int = 0, n_incidents: int = 10,
         service, _engine, _factory = _build_oracle_service(
             run_timeout_s, clock, journal)
         engines = []
-    elif backend in ("proc-cluster", "net-cluster"):
+    elif backend in ("proc-cluster", "net-cluster", "disagg-cluster"):
         raise ValueError(
             f"backend={backend!r} is chaos-soak-only (run_chaos_soak): "
             "the pipelined sweep returns live run handles that would "
-            "outlive the worker processes — use backend='cluster-oracle' "
-            "here, or run_chaos_soak for the out-of-process fleet")
+            "outlive the worker processes (and a mid-handoff run has no "
+            "stable home for a live handle) — use "
+            "backend='cluster-oracle' here, or run_chaos_soak for the "
+            "out-of-process / disaggregated fleet")
     else:
         raise ValueError(f"unknown backend {backend!r}")
 

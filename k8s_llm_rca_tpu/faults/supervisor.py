@@ -197,12 +197,20 @@ class ReplicaKiller:
         replica = self.router.replicas[victim]
         is_proc = hasattr(replica, "kill_process")
         health = getattr(self.router, "health", None)
+        # name the victim precisely in refusals: its worker kind and
+        # transport tell the reader WHICH fleet shape the plan mismatched
+        b_kind = getattr(replica.backend, "kind",
+                         type(replica.backend).__name__)
+        b_transport = getattr(replica.backend, "transport_kind",
+                              "in-process")
         mode = self.mode if mode is None else mode
         if mode == "auto":
             if is_proc:
                 raise ValueError(
                     f"ReplicaKiller(mode='auto') refuses out-of-process "
-                    f"replica {victim}: wedging a ProcReplica's proxy "
+                    f"replica {victim} (kind={b_kind!r}, "
+                    f"transport={b_transport!r}): wedging a "
+                    f"ProcReplica's proxy "
                     f"would only simulate a death the fleet could take "
                     f"for real — say mode='sigkill' (or ProcKiller) for "
                     f"a real SIGKILL, or mode='wedge' to simulate on "
@@ -223,7 +231,9 @@ class ReplicaKiller:
             if not getattr(replica, "supports_relink", False):
                 raise ValueError(
                     f"ReplicaKiller(mode={mode!r}) refuses replica "
-                    f"{victim}: partitioning needs a socket-transport "
+                    f"{victim} (kind={b_kind!r}, "
+                    f"transport={b_transport!r}): partitioning needs a "
+                    f"socket-transport "
                     f"ProcReplica (transport='socket') — a pipe/in-"
                     f"process replica has no network link to cut")
             if health is None:
@@ -238,7 +248,8 @@ class ReplicaKiller:
                 raise ValueError(
                     f"ReplicaKiller(mode='sigkill') needs an out-of-"
                     f"process victim with kill_process() (cluster/"
-                    f"proc.py ProcReplica); replica {victim} is "
+                    f"proc.py ProcReplica); replica {victim} "
+                    f"(kind={b_kind!r}, transport={b_transport!r}) is "
                     f"in-process — use mode='wedge'/'auto'")
             replica.kill_process()
             if health is None:
@@ -321,3 +332,121 @@ class NetKiller(ReplicaKiller):
     def __init__(self, plan: FaultPlan, router=None,
                  mode: str = "partition"):
         super().__init__(plan, router, mode=mode)
+
+
+class HandoffKiller(ReplicaKiller):
+    """Kill a tier member EXACTLY between EXPORT and ADOPT — the one
+    window where a death could tear a sequence in two (cluster/disagg.py
+    ``TierRouter._attempt_handoff`` opens the window on every transfer
+    attempt).
+
+    Discipline differs from the incident-boundary killers on purpose:
+    ``checkpoint()`` is a no-op (the soak still calls it once per
+    incident for uniformity, but nothing is polled there — a mid-handoff
+    kill is only meaningful mid-handoff), and ``window()`` polls this
+    killer's OWN FaultPlan exactly once per transfer attempt.  Fault
+    kinds: "crash" (SIGKILL the victim's worker — real OS death between
+    the two phases), "partition"/"halfopen" (sever a socket victim's
+    link mid-handoff).  ``target`` picks which side dies: "prefill" (the
+    exporter — the run must re-prefill on a surviving prefill replica),
+    "decode" (the adopter — ordinary failover on another decode
+    replica), or "alternate" (the fault's poll index picks a side, so a
+    seeded plan exercises both).
+
+    The TierRouter observes the carnage on its very next step: the
+    post-window re-lookup sees the victim dead or the run moved, counts
+    a retried handoff, and leaves the run wherever the failover placed
+    it — never half-adopted.  Victims killed here pre-stamp their
+    backend's ``death_kind`` as "handoff" so the watchdog's
+    hard-evidence breakdown (``health.hard_kinds``, the
+    ``cluster_hard_detections{kind=}`` Prometheus counter) attributes
+    the death to the handoff window, not a generic proc death."""
+
+    site = inject.SITE_HANDOFF
+    TARGETS = ("prefill", "decode", "alternate")
+
+    def __init__(self, plan: FaultPlan, router=None,
+                 mode: str = "sigkill", target: str = "prefill"):
+        if target not in self.TARGETS:
+            raise ValueError(f"unknown handoff kill target {target!r}: "
+                             f"expected one of {self.TARGETS}")
+        super().__init__(plan, router, mode=mode)
+        self.target = target
+        self.windows = 0       # EXPORT->ADOPT windows opened
+
+    def checkpoint(self) -> Optional[int]:
+        """Incident-boundary no-op: this killer only fires inside the
+        EXPORT->ADOPT window (``window()``), never at boundaries — the
+        soak calls checkpoint on every killer uniformly, and a poll here
+        would double-count the plan per incident."""
+        return None
+
+    def window(self, router, ghandle: int, src_rid: int,
+               dst_rid: int) -> Optional[int]:
+        """The EXPORT->ADOPT window for one transfer attempt: poll the
+        killer's own plan ONCE; on a scheduled fault, kill the targeted
+        tier member while the exported frame is in flight.  Returns the
+        victim's replica id, else None."""
+        if self.router is None:
+            self.router = router
+        self.windows += 1
+        fault = self.plan.poll(self.site)
+        if fault is None:
+            return None
+        if fault.kind in ("partition", "halfopen"):
+            mode = fault.kind
+        elif fault.kind == "crash":
+            mode = self.mode
+        else:
+            log.warning("handoff fault %r ignored: only 'crash'/"
+                        "'partition'/'halfopen' are meaningful at %s "
+                        "(frame kinds drop/corrupt/delay/stale-fence "
+                        "belong on the TierRouter's handoff_plan)",
+                        fault.kind, self.site)
+            return None
+        if self.target == "prefill":
+            victim = src_rid
+        elif self.target == "decode":
+            victim = dst_rid
+        else:
+            victim = (src_rid, dst_rid)[fault.index % 2]
+        alive = self.router.alive_ids()
+        sup = getattr(self.router, "supervisor", None)
+        restart_on = sup is not None and getattr(sup, "restart_enabled",
+                                                 False)
+        if (mode not in ("partition", "halfopen") and len(alive) <= 1
+                and not restart_on):
+            # partitions heal by relink (no replica lost) — every other
+            # mode removes a replica, so the last-alive policy applies
+            log.warning("mid-handoff kill skipped: %d replica(s) alive "
+                        "and no restart-enabled supervisor", len(alive))
+            return None
+        replica = self.router.replicas[victim]
+        if mode == "sigkill":
+            if not hasattr(replica, "kill_process"):
+                # in-process tier member: no OS process to SIGKILL —
+                # wedge if a watchdog can detect it, else fail directly
+                # (same deterministic healing path either way)
+                health = getattr(self.router, "health", None)
+                self._kill(victim,
+                           "wedge" if health is not None else "fail")
+            else:
+                backend = replica.backend
+                if getattr(backend, "death_kind", False) is None:
+                    # stamp BEFORE the kill: evidence_kind() returns the
+                    # first-stamped kind, so the watchdog attributes
+                    # this death to the handoff window
+                    backend.death_kind = "handoff"
+                replica.kill_process()
+                if getattr(self.router, "health", None) is None:
+                    self.router.fail_replica(victim)
+        else:
+            self._kill(victim, mode)
+        self.kills.append(victim)
+        METRICS.inc("faults.handoff_kills")
+        log.warning("mid-handoff kill #%d: replica %d (%s side) killed "
+                    "between EXPORT and ADOPT of run %d",
+                    len(self.kills), victim,
+                    "prefill" if victim == src_rid else "decode",
+                    ghandle)
+        return victim

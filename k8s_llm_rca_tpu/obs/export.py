@@ -126,6 +126,24 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                                     s.prefix_promoted_pages,
                                     "bytes_restored":
                                     s.prefix_bytes_restored}})
+        # hard-evidence death counter track, synthesized from the
+        # watchdog's cluster.health DEAD events (cluster/health.py
+        # _mark_dead): one "C" sample per detection, args carry the
+        # RUNNING count per evidence kind ("proc"/"link"/"handoff"), so
+        # Perfetto shows the detection mix climbing over the soak —
+        # mirror of the cluster_hard_detections{kind=} Prometheus family
+        hard: Dict[str, int] = {}
+        for ev in tracer.events:
+            if (ev.name != "cluster.health"
+                    or ev.args.get("state") != "dead"
+                    or ev.args.get("evidence") is None):
+                continue
+            hard[str(ev.args.get("kind", "proc"))] = (
+                hard.get(str(ev.args.get("kind", "proc")), 0) + 1)
+            events.append({"ph": "C", "ts": _us(ev.ts), "pid": 1,
+                           "tid": ev.tid,
+                           "name": "cluster.hard_detections",
+                           "args": {k: hard[k] for k in sorted(hard)}})
     # stable sort: equal-ts events keep recording order, so the document
     # is a pure function of the recording (byte-identity under VirtualClock)
     events.sort(key=lambda e: e["ts"])
@@ -383,6 +401,21 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
             for rid in sorted(router.replicas):
                 fam_h.add(code.get(health.state(rid), 0),
                           labels=f'{{replica="{rid}"}}')
+            # hard-evidence death verdicts by evidence kind: "proc"
+            # (OS process death), "link" (relink budget exhausted),
+            # "handoff" (killed inside the EXPORT->ADOPT window of a
+            # KV handoff — faults/supervisor.py HandoffKiller stamps
+            # the backend's death_kind before the SIGKILL)
+            kinds: Dict[str, int] = {}
+            for kind in health.hard_kinds:
+                kinds[kind] = kinds.get(kind, 0) + 1
+            if kinds:
+                fam_hd = family(
+                    f"{_PREFIX}cluster_hard_detections_total", "counter",
+                    "watchdog DEAD verdicts backed by hard evidence, "
+                    "by evidence kind (proc/link/handoff)")
+                for kind in sorted(kinds):
+                    fam_hd.add(kinds[kind], labels=f'{{kind="{kind}"}}')
 
     return "\n".join(families[n].render()
                      for n in sorted(families)) + "\n"

@@ -103,6 +103,10 @@ SITES = frozenset({
     # session nonce
     "cluster.net.partition",
     "cluster.net.relink",
+    # disaggregated tiers (cluster/disagg.py): one event per handoff
+    # outcome — a committed EXPORT -> ADOPT -> RELEASE transfer, or a
+    # retried attempt discarded whole (args carry the stage and reason)
+    "cluster.handoff",
     # graph layer
     "graph.query",
     # rca pipeline stages

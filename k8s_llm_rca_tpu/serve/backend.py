@@ -17,6 +17,7 @@ this kills the JSONDecodeError retry loop the reference needs
 
 from __future__ import annotations
 
+import base64
 import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, Sequence, Set, Tuple
@@ -25,6 +26,7 @@ from k8s_llm_rca_tpu.engine.constrain import make_grammar
 from k8s_llm_rca_tpu.engine.engine import InferenceEngine
 from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.obs import trace as obs_trace
+from k8s_llm_rca_tpu.utils import pages, wal
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
 
@@ -372,6 +374,102 @@ class EngineBackend:
         handles = [self._seq_to_handle[s["seq_id"]]
                    for s in snap.get("sequences", [])]
         return snap, handles
+
+    def export_run(self, handle: int) -> Optional[Dict[str, object]]:
+        """Per-run EXPORT for the disaggregated handoff
+        (cluster/disagg.py): freeze ONE live run and return its wire
+        frame ``{"seq": <snapshot entry>, "kv": None | {"b64", "length",
+        "cur_token"}}`` — the entry is the durable token state, the kv
+        block (when the paged engine could spill it) is the CRC-framed
+        ``utils/pages.py`` disk codec, base64'd so the frame stays
+        JSON-safe over the proc transports.  The run STAYS live here
+        until the adopter acks and the caller cancels this handle
+        (RELEASE).  None = nothing to export right now: unknown/settled
+        handle (the run raced to completion — not a retry), an injected
+        stall/failure, or an engine state that cannot freeze this pump
+        (chunked prefill in flight).  Never raises for a missing run:
+        the handoff queue self-cleans on the next pump."""
+        seq_id = self._handle_seq.get(handle)
+        if seq_id is None or not self._live.get(handle, False):
+            return None
+        if hasattr(self.engine, "flush_prefix_store"):
+            # publish resident prefix pages first so a re-prefill after
+            # a failed handoff is a mostly-HIT path on any replica
+            self.engine.flush_prefix_store()
+        exported = self.engine.export_run(seq_id)
+        if exported is None:
+            return None
+        entry, kv = exported
+        frame: Dict[str, object] = {"seq": entry, "kv": None}
+        if kv is not None:
+            try:
+                blob = pages.encode_page_record(
+                    {k: kv[k] for k in
+                     ("n_pages",) + pages.record_fields(kv)})
+            except ValueError:
+                blob = None     # record too large to frame: entry-only
+            if blob is not None:
+                b64 = base64.b64encode(blob).decode("ascii")
+                if len(b64) + 4096 <= wal.MAX_RECORD_SIZE:
+                    frame["kv"] = {"b64": b64,
+                                   "length": int(kv["length"]),
+                                   "cur_token": int(kv["cur_token"])}
+        return frame
+
+    def adopt_run(self, frame: Dict[str, object],
+                  opts: GenOptions) -> int:
+        """Per-run ADOPT: validate the ENTIRE frame before any engine
+        state moves, then re-admit the run under a fresh seq id/handle.
+        A malformed entry or a torn/corrupt kv blob raises ValueError —
+        the transfer is discarded whole and the caller retries from the
+        still-pinned source; this backend is left untouched.  A kv
+        record that decodes but does not fit this engine (different
+        pool layout) is silently dropped by the engine's own adopt
+        validation — the run re-prefills, byte-identical output."""
+        entry = frame.get("seq") if isinstance(frame, dict) else None
+        if (not isinstance(entry, dict)
+                or not {"seq_id", "prompt_ids", "generated",
+                        "remaining_new_tokens",
+                        "stop_strings"} <= set(entry)):
+            raise ValueError(
+                "torn handoff frame: malformed sequence entry")
+        rec = None
+        kv = frame.get("kv")
+        if kv is not None:
+            try:
+                blob = base64.b64decode(kv["b64"], validate=True)
+                rec = pages.decode_page_record(blob)
+            except Exception:
+                raise ValueError(
+                    "torn handoff frame: kv blob failed base64/frame "
+                    "decoding; transfer discarded whole")
+            if rec is None:
+                raise ValueError(
+                    "torn handoff frame: kv page record failed CRC/"
+                    "layout checks; transfer discarded whole")
+            rec["n_shared"] = 0
+            rec["shared_pages"] = []
+            rec["length"] = int(kv["length"])
+            rec["cur_token"] = int(kv["cur_token"])
+        new_id = next(self.engine._seq_counter)
+        grammar = None
+        if entry.get("grammar"):
+            if opts.grammar is None:
+                raise ValueError(
+                    f"seq {entry['seq_id']} was grammar-constrained but "
+                    f"its GenOptions carries no grammar spec; the FSM "
+                    f"is rebuilt from the spec at adoption")
+            grammar = make_grammar(
+                opts.grammar, self.tokenizer,
+                prefer_native=self.engine.engine_cfg.native)
+        self.engine.adopt_run(dict(entry, seq_id=new_id), kv=rec,
+                              grammar=grammar)
+        handle = next(self._handles)
+        self._seq_to_handle[new_id] = handle
+        self._handle_seq[handle] = new_id
+        self._opts[handle] = opts
+        self._live[handle] = True
+        return handle
 
     def host_counters(self) -> Dict[str, float]:
         """Cumulative host<->device traffic counters of the backing

@@ -126,6 +126,26 @@ def stack_pages(recs: Sequence[Dict[str, object]]) -> Dict[str, object]:
     return rec
 
 
+def pool_compatible(pool, rec: Dict[str, object]) -> bool:
+    """Whether a MULTI-page record's dtypes/shapes match THIS pool —
+    the handoff adopt check (cluster/disagg.py): a transfer record
+    gathered on a differently-configured prefill engine must be
+    rejected before any allocator state moves, not scattered as
+    garbage.  Page-count-aware sibling of ``records_compatible``."""
+    fields = (_KV_FIELDS + _SCALE_FIELDS if pool.quantized
+              else _KV_FIELDS)
+    if record_fields(rec) != fields:
+        return False
+    n = int(rec["n_pages"])
+    for f in fields:
+        arr = np.asarray(rec[f])
+        ref = getattr(pool, f)
+        want = (ref.shape[0], n) + tuple(ref.shape[2:])
+        if arr.shape != want or arr.dtype != ref.dtype:
+            return False
+    return True
+
+
 def records_compatible(pool, rec: Dict[str, object]) -> bool:
     """Whether a (per-page) record's dtypes/shapes match THIS pool —
     a store shared across engine configs must reject mismatched pages

@@ -644,6 +644,26 @@ class TestSiteCoverage:
         assert {"cluster.net.partition", "cluster.net.relink"} \
             <= tr_net.emitted_names()
 
+        # (11) disaggregated-tier sites: one run through an in-process
+        # echo TierRouter — admitted on the prefill tier, moved to the
+        # decode tier by the EXPORT -> ADOPT -> RELEASE handoff
+        # (cluster/disagg.py), which emits the cluster.handoff event
+        from k8s_llm_rca_tpu.cluster import TierRouter
+
+        tr_disagg = Tracer(clock=VirtualClock())
+        tracers.append(tr_disagg)
+        with obs_trace.tracing(tr_disagg):
+            disagg_router = TierRouter(
+                [Replica(0, EchoBackend(tok, delay_pumps=2))],
+                [Replica(1, EchoBackend(tok, delay_pumps=2))])
+            h_d = disagg_router.start("node notready", GenOptions())
+            disagg_out = {}
+            for _ in range(8):
+                disagg_out.update(disagg_router.pump())
+            assert disagg_out[h_d].error is None
+            assert disagg_router.handoffs == 1
+        assert "cluster.handoff" in tr_disagg.emitted_names()
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:
