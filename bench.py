@@ -1213,6 +1213,92 @@ def bench_disagg(n_runs: int = 6):
             "handoffs_retried": retried}
 
 
+def bench_autoscale(n_events: int = 32):
+    """Elastic autoscaler leg (cluster/autoscale.py): fresh interpreter,
+    measurement-or-null.
+
+    Trust argument: every number here is host-side Python wall-clock on
+    scripted metered-echo replicas — no device dispatch anywhere, so the
+    tunnel's memoizer and ~0.25 s dispatch latency cannot touch it.
+
+    - ``autoscale_scale_up_s``: p50 wall-clock of one ``scale_up()`` —
+      reserve pop, ``add_replica`` admission (disjointness checks,
+      health register) and the supervisor rebuild-recipe spawn.
+    - ``autoscale_drain_s``: p50 wall-clock of one ``scale_down()``
+      with live runs aboard — drain migration of every in-flight run
+      onto the survivors, staged retirement, and the submesh parking
+      back on the reserve.
+    - ``autoscale_chip_seconds_saved``: static-minus-elastic
+      chip-seconds over the seeded diurnal-ramp elastic soak
+      (faults/soak.py run_elastic_soak, VirtualClock-exact — a count,
+      not a timing), published only when the acceptance bar holds
+      (elastic p99 time-to-report <= static).
+    """
+    import time
+
+    from k8s_llm_rca_tpu.cluster import (
+        Autoscaler, ClusterRouter, HealthWatchdog, Replica,
+        ReplicaSupervisor, ScalePolicy,
+    )
+    from k8s_llm_rca_tpu.faults.plan import VirtualClock
+    from k8s_llm_rca_tpu.faults.soak import (
+        metered_echo_class, run_elastic_soak,
+    )
+    from k8s_llm_rca_tpu.serve.backend import GenOptions
+
+    cls = metered_echo_class()
+    tok = get_tokenizer()
+    mk = lambda i: Replica(i, cls(tok, 1),                  # noqa: E731
+                           rebuild=lambda: cls(tok, 1))
+    clock = VirtualClock()
+    router = ClusterRouter([mk(0)])
+    router.attach_health(HealthWatchdog(None, clock=clock),
+                         ReplicaSupervisor())
+    scaler = Autoscaler(
+        router, ScalePolicy(min_replicas=1, max_replicas=n_events + 2),
+        reserve=[mk(i) for i in range(1, n_events + 1)], clock=clock)
+    ups = []
+    for _ in range(n_events):
+        t0 = time.perf_counter()
+        scaler.scale_up()
+        ups.append(time.perf_counter() - t0)
+    ok = len(router.replicas) == n_events + 1
+    # live runs aboard every replica, so each drain below migrates work
+    opts = GenOptions(max_new_tokens=4)
+    handles = [router.start(f"autoscale bench run {i}", opts)
+               for i in range(3 * n_events)]
+    downs = []
+    for _ in range(n_events):
+        t0 = time.perf_counter()
+        scaler.scale_down()
+        downs.append(time.perf_counter() - t0)
+    ok = (ok and len(router.replicas) == 1
+          and scaler.scale_downs == n_events
+          and router.migrated_runs > 0)
+    out = {}
+    for _ in range(4 * len(handles)):
+        out.update(router.pump())
+        if len(out) == len(handles):
+            break
+    ok = (ok and len(out) == len(handles)
+          and all(r.error is None for r in out.values()))
+    ups.sort()
+    downs.sort()
+    scale_up_s = round(ups[len(ups) // 2], 6) if ok else None
+    drain_s = round(downs[len(downs) // 2], 6) if ok else None
+    # the acceptance-bar soak pair, VirtualClock-deterministic
+    elastic = run_elastic_soak(seed=0, elastic=True)
+    static = run_elastic_soak(seed=0, elastic=False)
+    re_, rs = elastic["report"], static["report"]
+    bar = (re_["failed"] == 0 and rs["failed"] == 0
+           and re_["p99_ttr_s"] <= rs["p99_ttr_s"]
+           and re_["chip_seconds"] < rs["chip_seconds"])
+    saved = (round(rs["chip_seconds"] - re_["chip_seconds"], 6)
+             if bar else None)
+    return {"scale_up_s": scale_up_s, "drain_s": drain_s,
+            "chip_seconds_saved": saved}
+
+
 def bench_host_overlap(n_prompts: int = 48, max_batch: int = 8,
                        prompt_len: int = 64, max_new: int = 32):
     """Overlapped-hot-loop leg (docs/performance.md): the TINY paged
@@ -1499,6 +1585,7 @@ def main():
     proc_cluster = _leg("bench.bench_proc_cluster()", timeout=1500) or {}
     net_cluster = _leg("bench.bench_net_cluster()", timeout=1500) or {}
     disagg = _leg("bench.bench_disagg()", timeout=1500) or {}
+    autoscale = _leg("bench.bench_autoscale()", timeout=1500) or {}
 
     def leg_fields(leg, prefix):
         # every named field ALWAYS appears (null when the leg failed or
@@ -1717,6 +1804,14 @@ def main():
         "disagg_handoff_ms_per_page": disagg.get("handoff_ms_per_page"),
         "disagg_ttft_p50_s": disagg.get("ttft_p50_s"),
         "disagg_handoffs_retried": disagg.get("handoffs_retried"),
+        # elastic fleet autoscaler (cluster/autoscale.py): p50 wall-clock
+        # of a reserve-pop scale-up and of a drain-everything scale-down
+        # on metered-echo replicas, plus static-minus-elastic
+        # chip-seconds over the seeded diurnal soak (null when the leg
+        # failed or the p99 acceptance bar did not hold)
+        "autoscale_scale_up_s": autoscale.get("scale_up_s"),
+        "autoscale_drain_s": autoscale.get("drain_s"),
+        "autoscale_chip_seconds_saved": autoscale.get("chip_seconds_saved"),
         "device": device_str,
     }
     if eng_tps and not sweep_ok:

@@ -27,9 +27,16 @@ Layer map (PARITY.md §cluster, docs/cluster.md):
 - ``disagg.TierRouter`` — disaggregated prefill/decode tiers over any
   of the above replica shapes, with a transactional (EXPORT -> ADOPT ->
   RELEASE) per-run KV handoff between the tiers that survives
-  mid-handoff kills (``faults.supervisor.HandoffKiller``).
+  mid-handoff kills (``faults.supervisor.HandoffKiller``);
+- ``autoscale.Autoscaler`` / ``ScalePolicy`` — the elastic control
+  loop: watermark-driven scale-up (supervisor rebuild-recipe spawn
+  onto a free submesh), drain-down (live sequences migrate, staged
+  ``close()``, submesh parked back on the reserve), and prefill<->
+  decode tier rebalancing via ``TierRouter.reassign_tier`` — all a
+  pure function of the gauge sequence under a frozen VirtualClock.
 """
 
+from k8s_llm_rca_tpu.cluster.autoscale import Autoscaler, ScalePolicy
 from k8s_llm_rca_tpu.cluster.disagg import (TIER_DECODE, TIER_PREFILL,
                                             TierRouter)
 from k8s_llm_rca_tpu.cluster.health import (ALIVE, DEAD, SUSPECT,
@@ -49,4 +56,5 @@ __all__ = [
     "ALIVE", "SUSPECT", "DEAD",
     "ProcReplica", "build_proc_replicas",
     "TierRouter", "TIER_PREFILL", "TIER_DECODE",
+    "Autoscaler", "ScalePolicy",
 ]

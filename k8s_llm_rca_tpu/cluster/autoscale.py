@@ -1,0 +1,540 @@
+"""Elastic fleet autoscaler: occupancy-driven scale-up/drain-down and
+prefill<->decode tier rebalancing as a deterministic control loop.
+
+The RCA sweep serves a fixed incident batch on fixed silicon, but the
+ROADMAP north star is production traffic — bursty and diurnal, not
+flat.  PRs 9-14 built every actuator an elastic fleet needs (supervisor
+spawn/respawn, per-replica occupancy/queue-depth gauges, live drain
+migration, TierRouter KV handoff); ``Autoscaler`` composes them into
+the control plane:
+
+- **scale-up** — when a tier's load (max of mean occupancy and mean
+  queue depth normalized by ``depth_capacity``) holds at or above
+  ``high_water`` for ``sustain_ticks`` consecutive ``evaluate()``
+  calls, pop the lowest-id parked replica off the reserve (a free
+  submesh with a ``rebuild`` recipe), admit it through
+  ``ClusterRouter.add_replica``, and spawn its worker through the
+  existing ``ReplicaSupervisor.restart`` rebuild-recipe path — the
+  same incarnation counting, health re-arm, and obs re-tag a healed
+  replica gets.  ``scale_up`` refuses loudly when no submesh is free.
+- **scale-down** — when the tier idles at or below ``low_water`` that
+  long, drain the least-loaded worker: engine replicas through
+  ``drain_replica`` (live sequences migrate WITH their KV), scripted
+  replicas through the deterministic re-start migration (the
+  ``fail_replica`` journal contract under ``inject.readmission``,
+  minus the failover counters — nothing died).  The worker is then
+  retired through its staged ``close()`` (ProcReplica's
+  drain→TERM→KILL ladder) and parked back on the reserve, freeing its
+  submesh.
+- **tier rebalance** — on a ``TierRouter``, when the prefill/decode
+  load split shifts past ``rebalance_band`` for
+  ``rebalance_sustain_ticks`` evaluations, drain a worker from the fat
+  tier within its own tier and re-admit it to the starved tier via
+  ``reassign_tier`` — the worker never dies, its warm engine state
+  rides along, and queued EXPORT→ADOPT→RELEASE handoffs simply re-look
+  up their source next pump, so no in-flight run is lost.
+
+Determinism contract (the health-watchdog contract): ``evaluate()`` is
+a pure function of the gauge sequence — no wall clock, no randomness;
+under a frozen ``VirtualClock`` the same gauge history yields the same
+decision list, and the chaos soak variant with killers armed DURING
+scale events settles ``report_bytes`` byte-identical run over run
+(faults/soak.py ``run_elastic_soak``).  Scale stats (``scale_ups`` /
+``scale_downs`` / ``rebalances`` / ``decisions``) live HERE, never in
+reports.
+
+While a replica is mid-drain or mid-retire it is flagged
+(``Replica.draining`` / ``Replica.retiring``) and every fault killer
+REFUSES to target it (faults/supervisor.py) — a kill inside that
+window would orphan the drain snapshot.
+
+Exclusions (loud ValueError, repo convention): un-attached health or a
+non-restarting supervisor, reserve replicas without rebuild recipes or
+with colliding ids/overlapping submeshes, watermark/hysteresis/
+cooldown nonsense in ``ScalePolicy``, scale-up past ``max_replicas``
+or with an empty reserve, scale-down below ``min_replicas`` (or a
+tier's last member).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from k8s_llm_rca_tpu.cluster.replica import Replica
+from k8s_llm_rca_tpu.faults import inject
+from k8s_llm_rca_tpu.obs import trace as obs_trace
+from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
+
+log = get_logger(__name__)
+
+_ALL = "all"   # the single pseudo-tier of an untiered ClusterRouter
+
+
+@dataclass(frozen=True)
+class ScalePolicy:
+    """Watermarks, hysteresis, and cooldown for the elastic loop.
+
+    ``high_water`` / ``low_water``: tier-load thresholds for scale-up /
+    scale-down, where load = max(mean occupancy, mean queue depth /
+    ``depth_capacity``) over the tier's healthy members.  The gap
+    between them IS the hysteresis band — a fleet sized so load sits
+    inside it takes no action.
+
+    ``sustain_ticks``: consecutive ``evaluate()`` calls a threshold
+    must hold before the actuator fires (one noisy gauge sample must
+    not flap the fleet).  ``cooldown_ticks``: evaluations to sit out
+    after ANY action, so the previous action's effect reaches the
+    gauges before the next is judged.
+
+    ``rebalance_band`` / ``rebalance_sustain_ticks``: the prefill vs
+    decode load DIFFERENCE (TierRouter only) that must persist before
+    a worker migrates from the fat tier to the starved one.
+    """
+
+    high_water: float = 0.75
+    low_water: float = 0.25
+    depth_capacity: int = 4
+    sustain_ticks: int = 3
+    cooldown_ticks: int = 5
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    rebalance_band: float = 0.25
+    rebalance_sustain_ticks: int = 3
+
+    def __post_init__(self):
+        if self.high_water <= 0.0:
+            raise ValueError(
+                f"high_water must be positive (it is a load threshold), "
+                f"got {self.high_water}")
+        if not 0.0 <= self.low_water < self.high_water:
+            raise ValueError(
+                f"low_water must sit in [0, high_water) — the gap is the "
+                f"hysteresis band that keeps the fleet from flapping — "
+                f"got low_water={self.low_water}, "
+                f"high_water={self.high_water}")
+        if self.depth_capacity < 1:
+            raise ValueError(
+                f"depth_capacity must be >= 1 (queue depth is normalized "
+                f"by it), got {self.depth_capacity}")
+        if self.sustain_ticks < 1:
+            raise ValueError(
+                f"sustain_ticks must be >= 1 (a threshold crossing must "
+                f"hold at least one evaluation), got {self.sustain_ticks}")
+        if self.cooldown_ticks < 0:
+            raise ValueError(
+                f"cooldown_ticks must be >= 0, got {self.cooldown_ticks}")
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1 (a fleet of zero cannot "
+                f"serve), got {self.min_replicas}")
+        if (self.max_replicas is not None
+                and self.max_replicas <= self.min_replicas):
+            raise ValueError(
+                f"max_replicas must exceed min_replicas (an elastic band "
+                f"needs room to move), got max_replicas="
+                f"{self.max_replicas} <= min_replicas={self.min_replicas}")
+        if not 0.0 < self.rebalance_band < 1.0:
+            raise ValueError(
+                f"rebalance_band must sit in (0, 1) — it is a load "
+                f"DIFFERENCE with hysteresis, got {self.rebalance_band}")
+        if self.rebalance_sustain_ticks < 1:
+            raise ValueError(
+                f"rebalance_sustain_ticks must be >= 1, got "
+                f"{self.rebalance_sustain_ticks}")
+
+
+class Autoscaler:
+    """The elastic control loop over a (Tier)ClusterRouter.
+
+    ``reserve``: parked ``Replica`` objects — the free submeshes.  Each
+    must carry a ``rebuild`` recipe (how a free submesh spawns a
+    worker); they are parked ``alive=False`` and revived through the
+    supervisor on scale-up.  Retired workers return here, so the
+    reserve IS the free-submesh ledger.
+
+    Call ``evaluate()`` once per control tick (the soak drivers call it
+    once per loop iteration).  At most ONE action fires per tick,
+    preference order scale-up > rebalance > scale-down — capacity
+    before savings.  All decisions land in ``self.decisions`` and as
+    ``cluster.scale`` trace events; the router gets an ``autoscaler``
+    backref so obs/export.py can render fleet-size gauges and
+    scale-event counters.
+    """
+
+    def __init__(self, router, policy: Optional[ScalePolicy] = None,
+                 reserve: Sequence[Replica] = (), clock=None):
+        if getattr(router, "health", None) is None:
+            raise ValueError(
+                "Autoscaler needs a health-attached router "
+                "(ClusterRouter.attach_health with a HealthWatchdog): "
+                "the control loop reads the watchdog-probed fleet and "
+                "scale events re-arm through its register/reset path")
+        sup = getattr(router, "supervisor", None)
+        if sup is None or not sup.restart_enabled:
+            raise ValueError(
+                "Autoscaler needs a restart-enabled ReplicaSupervisor "
+                "on the router: scale-up spawns workers through the "
+                "rebuild-recipe restart path")
+        self.router = router
+        self.policy = policy or ScalePolicy()
+        self.clock = clock
+        reserve = sorted(reserve, key=lambda r: r.replica_id)
+        seen = set(router.replicas)
+        for r in reserve:
+            if r.rebuild is None:
+                raise ValueError(
+                    f"reserve replica {r.replica_id} has no rebuild "
+                    f"recipe: a free submesh must know how to spawn a "
+                    f"worker (build_replicas records one per engine "
+                    f"replica)")
+            if r.replica_id in seen:
+                raise ValueError(
+                    f"reserve replica id {r.replica_id} collides with "
+                    f"the fleet/reserve (ids must be unique across both)")
+            seen.add(r.replica_id)
+            r.alive = False            # parked: not serving, not probed
+        meshes = ([x.mesh for x in router.replicas.values()
+                   if x.mesh is not None]
+                  + [x.mesh for x in reserve if x.mesh is not None])
+        if meshes:
+            from k8s_llm_rca_tpu.engine.engine import (
+                validate_disjoint_submeshes,
+            )
+
+            validate_disjoint_submeshes(meshes)
+        self.reserve: List[Replica] = reserve
+        self._tick = 0
+        self._cooldown = 0
+        self._over: Dict[str, int] = {}     # tier -> ticks at/above high
+        self._under: Dict[str, int] = {}    # tier -> ticks at/below low
+        self._skew: Dict[str, int] = {}     # hot tier -> ticks past band
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.rebalances = 0
+        self.decisions: List[Dict[str, Any]] = []
+        router.autoscaler = self            # obs backref (export.py)
+
+    # ------------------------------------------------------------- gauges
+
+    def _now(self) -> float:
+        if self.clock is not None:
+            return self.clock.time()
+        if inject._ARMED is not None:
+            return inject._ARMED.clock.time()
+        import time
+
+        return time.time()
+
+    def _tiered(self) -> bool:
+        return hasattr(self.router, "tier")
+
+    def _tiers(self) -> List[str]:
+        if self._tiered():
+            from k8s_llm_rca_tpu.cluster.disagg import (TIER_DECODE,
+                                                        TIER_PREFILL)
+
+            return [TIER_PREFILL, TIER_DECODE]
+        return [_ALL]
+
+    def _members(self, tier: str) -> List[int]:
+        """Healthy, probe-trusted, not-mid-scale members of ``tier`` —
+        the population the gauges average over AND the scale-down
+        victim pool."""
+        router = self.router
+        tmap = getattr(router, "tier", None)
+        out = []
+        for rid, r in router.replicas.items():
+            if tier != _ALL and (tmap or {}).get(rid) != tier:
+                continue
+            if not r.healthy() or r.draining or r.retiring:
+                continue
+            if router.health.is_suspect(rid):
+                continue
+            out.append(rid)
+        return out
+
+    def load(self, tier: str) -> float:
+        """Tier load in [0, inf): max of mean occupancy and mean queue
+        depth over ``depth_capacity``.  Scripted replicas report 0.0
+        occupancy (cluster/replica.py), so queue depth drives them;
+        engine replicas contribute whichever signal is hotter."""
+        members = self._members(tier)
+        if not members:
+            return 0.0
+        reps = self.router.replicas
+        occ = sum(reps[r].occupancy() for r in members) / len(members)
+        depth = (sum(reps[r].queue_depth() for r in members)
+                 / len(members) / self.policy.depth_capacity)
+        return max(occ, depth)
+
+    def fleet_sizes(self) -> Dict[str, int]:
+        """Alive replicas per tier (``{"all": n}`` untiered) — the
+        ``cluster_fleet_size{tier=}`` gauge source."""
+        router = self.router
+        if not self._tiered():
+            return {_ALL: len(router.alive_ids())}
+        sizes: Dict[str, int] = {t: 0 for t in self._tiers()}
+        for rid in router.alive_ids():
+            t = router.tier.get(rid)
+            if t in sizes:
+                sizes[t] += 1
+        return sizes
+
+    # ----------------------------------------------------------- the loop
+
+    def evaluate(self) -> Optional[Dict[str, Any]]:
+        """One control tick: fold the current gauges into the sustain
+        counters and fire at most one actuator.  Returns the decision
+        record (also appended to ``self.decisions``) or None."""
+        self._tick += 1
+        p = self.policy
+        tiers = self._tiers()
+        loads = {t: self.load(t) for t in tiers}
+        for t in tiers:
+            self._over[t] = self._over.get(t, 0) + 1 \
+                if loads[t] >= p.high_water else 0
+            self._under[t] = self._under.get(t, 0) + 1 \
+                if loads[t] <= p.low_water else 0
+        if self._tiered():
+            from k8s_llm_rca_tpu.cluster.disagg import (TIER_DECODE,
+                                                        TIER_PREFILL)
+
+            diff = loads[TIER_PREFILL] - loads[TIER_DECODE]
+            hot = (TIER_PREFILL if diff >= p.rebalance_band
+                   else TIER_DECODE if -diff >= p.rebalance_band
+                   else None)
+            for t in (TIER_PREFILL, TIER_DECODE):
+                self._skew[t] = self._skew.get(t, 0) + 1 \
+                    if t == hot else 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        decision = None
+        for t in tiers:
+            if (self._over[t] >= p.sustain_ticks
+                    and self._can_scale_up()):
+                decision = self.scale_up(t if self._tiered() else None)
+                self._over[t] = 0
+                break
+        if decision is None and self._tiered():
+            from k8s_llm_rca_tpu.cluster.disagg import (TIER_DECODE,
+                                                        TIER_PREFILL)
+
+            for hot, fat in ((TIER_PREFILL, TIER_DECODE),
+                             (TIER_DECODE, TIER_PREFILL)):
+                if (self._skew.get(hot, 0) >= p.rebalance_sustain_ticks
+                        and len(self._members(fat)) >= 2):
+                    decision = self.rebalance(fat, hot)
+                    self._skew[hot] = 0
+                    break
+        if decision is None:
+            for t in tiers:
+                if (self._under[t] >= p.sustain_ticks
+                        and self._can_scale_down(t)):
+                    decision = self.scale_down(
+                        t if self._tiered() else None)
+                    self._under[t] = 0
+                    break
+        if decision is not None:
+            self._cooldown = p.cooldown_ticks
+        return decision
+
+    def _can_scale_up(self) -> bool:
+        p = self.policy
+        if not self.reserve:
+            return False    # at capacity: evaluate() waits, never raises
+        return (p.max_replicas is None
+                or len(self.router.replicas) < p.max_replicas)
+
+    def _can_scale_down(self, tier: str) -> bool:
+        members = self._members(tier)
+        floor_ok = len(self.router.alive_ids()) > self.policy.min_replicas
+        if tier != _ALL:
+            floor_ok = floor_ok and len(members) > 1
+        return floor_ok and bool(members)
+
+    # ---------------------------------------------------------- actuators
+
+    def scale_up(self, tier: Optional[str] = None) -> Dict[str, Any]:
+        """Spawn one worker onto a free submesh via the supervisor's
+        rebuild-recipe path.  Refuses loudly when no submesh is free or
+        the fleet is already at ``max_replicas``."""
+        router = self.router
+        p = self.policy
+        if self._tiered() and tier is None:
+            raise ValueError(
+                "scale_up on a TierRouter needs the tier to grow "
+                "('prefill' or 'decode')")
+        if (p.max_replicas is not None
+                and len(router.replicas) >= p.max_replicas):
+            raise ValueError(
+                f"refusing to scale up: fleet already at max_replicas="
+                f"{p.max_replicas} (ids: {sorted(router.replicas)})")
+        if not self.reserve:
+            raise ValueError(
+                f"no free submesh: the reserve is empty (fleet: "
+                f"{sorted(router.replicas)}) — scale-up needs a parked "
+                f"Replica with a rebuild recipe to spawn onto")
+        replica = self.reserve.pop(0)
+        rid = replica.replica_id
+        if self._tiered():
+            router.add_replica(replica, tier=tier)
+        else:
+            router.add_replica(replica)
+        # the ReplicaSupervisor rebuild-recipe spawn: fresh backend
+        # incarnation, obs re-tag, health re-arm — identical to a heal
+        router.supervisor.restart(rid)
+        self.scale_ups += 1
+        return self._record("up", tier or _ALL, rid)
+
+    def scale_down(self, tier: Optional[str] = None) -> Dict[str, Any]:
+        """Drain the least-loaded worker of ``tier`` and retire it:
+        live sequences migrate (KV snapshot/adopt for engine replicas,
+        journal-contract re-start for scripted ones), the staged
+        ``close()`` runs if the replica has one, and the worker parks
+        back on the reserve as a free submesh."""
+        router = self.router
+        if self._tiered() and tier is None:
+            raise ValueError(
+                "scale_down on a TierRouter needs the tier to shrink "
+                "('prefill' or 'decode')")
+        t = tier or _ALL
+        members = self._members(t)
+        if not members:
+            raise ValueError(
+                f"refusing to scale down: no healthy non-draining "
+                f"{t} replica to retire")
+        if len(router.alive_ids()) <= self.policy.min_replicas:
+            raise ValueError(
+                f"refusing to scale down: fleet at min_replicas="
+                f"{self.policy.min_replicas}")
+        if t != _ALL and len(members) <= 1:
+            raise ValueError(
+                f"refusing to scale down: replica {members[0]} is the "
+                f"last healthy {t} tier member")
+        rid = min(members,
+                  key=lambda r: (router.replicas[r].queue_depth(), r))
+        replica = router.replicas[rid]
+        migrated = self._drain_out(replica)
+        replica.retiring = True
+        try:
+            close = getattr(replica, "close", None)
+            if close is not None:
+                close()            # staged drain->TERM->KILL ladder
+            router.remove_replica(rid)
+        finally:
+            replica.retiring = False
+        replica.alive = False
+        self.reserve.append(replica)
+        self.reserve.sort(key=lambda r: r.replica_id)
+        self.scale_downs += 1
+        return self._record("down", t, rid, migrated=migrated)
+
+    def rebalance(self, fat: str, starved: str) -> Dict[str, Any]:
+        """Move one worker from the ``fat`` tier to the ``starved``
+        tier without killing it: drain its sequences within its own
+        tier, flip its tier via ``reassign_tier`` (warm engine state
+        rides along; queued handoffs re-look up their source next
+        pump), and revive it with a fresh health baseline."""
+        router = self.router
+        if not self._tiered():
+            raise ValueError(
+                "rebalance needs a TierRouter (plain ClusterRouter "
+                "fleets have no prefill/decode split to rebalance)")
+        members = self._members(fat)
+        if len(members) < 2:
+            raise ValueError(
+                f"refusing to rebalance: the {fat} tier has "
+                f"{len(members)} healthy member(s) and must keep one")
+        rid = min(members,
+                  key=lambda r: (router.replicas[r].queue_depth(), r))
+        replica = router.replicas[rid]
+        migrated = self._drain_out(replica)
+        router.reassign_tier(rid, starved)
+        replica.alive = True
+        replica.wedged = False
+        router.health.reset(rid)   # fresh baseline in the new tier
+        self.rebalances += 1
+        return self._record("rebalance", starved, rid, migrated=migrated,
+                            src_tier=fat)
+
+    # ------------------------------------------------------------ internals
+
+    def _drain_out(self, replica: Replica) -> int:
+        """Empty ``replica`` under the mid-drain killer shield: engine
+        replicas through ``drain_replica`` (sequences move WITH their
+        KV), scripted ones through the re-start migration.  Leaves the
+        replica not-alive with zero in-flight runs."""
+        router = self.router
+        rid = replica.replica_id
+        replica.draining = True
+        try:
+            if router._orphans(rid):
+                if hasattr(replica.backend, "snapshot_sequences"):
+                    migrated = len(router.drain_replica(rid))
+                else:
+                    migrated = self._migrate_scripted(rid)
+            else:
+                migrated = 0
+                replica.alive = False
+                for session in [s for s, r in router._affinity.items()
+                                if r == rid]:
+                    del router._affinity[session]
+        finally:
+            replica.draining = False
+        return migrated
+
+    def _migrate_scripted(self, rid: int) -> int:
+        """Scripted drain-down: scripted backends have no KV snapshot
+        seam (``drain_replica`` refuses them by design), so the live
+        runs migrate by deterministic re-start on the survivors under
+        their existing global handles — the ``fail_replica`` journal
+        contract under ``inject.readmission``, minus the failover
+        counters, because nothing died."""
+        router = self.router
+        replica = router.replicas[rid]
+        replica.alive = False
+        orphans = router._orphans(rid)
+        for ghandle in orphans:
+            _, lhandle = router._handle_map[ghandle]
+            router._local.pop((rid, lhandle), None)
+            replica.backend.cancel(lhandle)
+        for session in [s for s, r in router._affinity.items()
+                        if r == rid]:
+            del router._affinity[session]
+        tiered = self._tiered()
+        prev = router._route_tier if tiered else None
+        if tiered:
+            router._route_tier = router.tier.get(rid)
+        try:
+            for ghandle in orphans:
+                prompt, opts = router._runs[ghandle]
+                new_rid = router._pick(opts.session, admit=False)
+                with inject.readmission():
+                    nl = router.replicas[new_rid].backend.start(prompt,
+                                                                opts)
+                router._handle_map[ghandle] = (new_rid, nl)
+                router._local[(new_rid, nl)] = ghandle
+        finally:
+            if tiered:
+                router._route_tier = prev
+        if orphans:
+            router.migrated_runs += len(orphans)
+            METRICS.inc("cluster.migrated_runs", len(orphans))
+        return len(orphans)
+
+    def _record(self, kind: str, tier: str, rid: int,
+                **extra: Any) -> Dict[str, Any]:
+        sizes = self.fleet_sizes()
+        decision = {"tick": self._tick, "kind": kind, "tier": tier,
+                    "replica": rid, "fleet": sum(sizes.values()),
+                    **extra}
+        self.decisions.append(decision)
+        obs_trace.event("cluster.scale", kind=kind, tier=tier,
+                        replica=rid, fleet=decision["fleet"],
+                        reserve=len(self.reserve), **extra)
+        log.info("autoscale %s: replica %d (%s tier), fleet now %s, "
+                 "%d submesh(es) free", kind, rid, tier, sizes,
+                 len(self.reserve))
+        return decision

@@ -222,6 +222,101 @@ class TierRouter(ClusterRouter):
         finally:
             self._route_tier = prev
 
+    # ----------------------------------------------------- fleet membership
+
+    def _check_tier_member(self, replica: Replica) -> None:
+        """The __init__ member exclusions, applied to a late admission:
+        no cp/pp mesh axes, and the newcomer must sit on the SAME
+        handoff seam as the incumbent fleet."""
+        axes = tuple(getattr(getattr(replica, "mesh", None),
+                             "axis_names", ()) or ())
+        bad = [a for a in axes if a in ("cp", "pp")]
+        if bad:
+            raise ValueError(
+                f"TierRouter refuses replica {replica.replica_id} with "
+                f"mesh axes {axes}: {bad[0]!r}-sharded KV has no "
+                f"host-safe per-page image to move between tiers")
+        seam = hasattr(replica.backend, "export_run")
+        if seam != self._kv_seam:
+            kind = "scripted" if not seam else "engine-backed"
+            fleet = "engine-backed" if self._kv_seam else "scripted"
+            raise ValueError(
+                f"TierRouter refuses replica {replica.replica_id}: it is "
+                f"{kind} while the fleet is {fleet} — every tier member "
+                f"must sit on the same handoff seam")
+
+    def add_replica(self, replica: Replica,
+                    tier: Optional[str] = None) -> None:
+        """Tiered admission (the elastic scale-up seam): the newcomer
+        must name its tier, pass the same member exclusions as
+        ``__init__``, and lands in the sorted tier id lists."""
+        if tier not in (TIER_PREFILL, TIER_DECODE):
+            raise ValueError(
+                f"add_replica on a TierRouter needs tier="
+                f"{TIER_PREFILL!r} or {TIER_DECODE!r}, got {tier!r}")
+        self._check_tier_member(replica)
+        self._admit_replica(replica)
+        self.tier[replica.replica_id] = tier
+        self._rebuild_tier_ids()
+
+    def remove_replica(self, rid: int) -> Replica:
+        """Tiered retirement: refuses to empty a tier (the __init__
+        invariant — a TierRouter without a prefill or decode tier
+        cannot serve)."""
+        tier = self.tier.get(rid)
+        if tier is not None:
+            peers = [r for r in self.replicas
+                     if r != rid and self.tier.get(r) == tier]
+            if not peers:
+                raise ValueError(
+                    f"refusing to remove replica {rid}: it is the last "
+                    f"{tier} tier member (an empty tier cannot serve — "
+                    f"add or reassign a peer first)")
+        replica = super().remove_replica(rid)
+        self.tier.pop(rid, None)
+        self._rebuild_tier_ids()
+        return replica
+
+    def reassign_tier(self, rid: int, tier: str) -> None:
+        """Move ``rid`` to the other tier in place (the rebalance seam,
+        cluster/autoscale.py): the worker never dies, its warm engine
+        state rides along.  Refuses while the replica still owns
+        in-flight runs — pre-handoff sequences would silently change
+        phase — and when leaving would empty its current tier."""
+        if tier not in (TIER_PREFILL, TIER_DECODE):
+            raise ValueError(
+                f"reassign_tier needs tier={TIER_PREFILL!r} or "
+                f"{TIER_DECODE!r}, got {tier!r}")
+        cur = self.tier.get(rid)
+        if cur is None:
+            raise ValueError(
+                f"replica {rid} is not in the fleet "
+                f"(ids: {sorted(self.replicas)})")
+        if cur == tier:
+            raise ValueError(
+                f"replica {rid} already sits in the {tier} tier")
+        orphans = self._orphans(rid)
+        if orphans:
+            raise ValueError(
+                f"refusing to reassign replica {rid} to the {tier} "
+                f"tier: it still owns {len(orphans)} in-flight run(s) "
+                f"whose phase would silently change — drain it first")
+        peers = [r for r in self.replicas
+                 if r != rid and self.tier.get(r) == cur]
+        if not peers:
+            raise ValueError(
+                f"refusing to reassign replica {rid}: it is the last "
+                f"{cur} tier member (an empty tier cannot serve)")
+        self.tier[rid] = tier
+        self._rebuild_tier_ids()
+        log.info("replica %d reassigned %s -> %s tier", rid, cur, tier)
+
+    def _rebuild_tier_ids(self) -> None:
+        self.prefill_ids = sorted(
+            r for r, t in self.tier.items() if t == TIER_PREFILL)
+        self.decode_ids = sorted(
+            r for r, t in self.tier.items() if t == TIER_DECODE)
+
     # -------------------------------------------------------------- handoff
 
     @staticmethod

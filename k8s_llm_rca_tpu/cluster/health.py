@@ -156,6 +156,15 @@ class HealthWatchdog:
 
     reset = register   # a restarted incarnation re-arms the same way
 
+    def unregister(self, rid: int) -> None:
+        """Stop watching ``rid`` (scale-down retirement,
+        cluster/autoscale.py ``Autoscaler``): drop every per-replica
+        signal so a stale verdict cannot leak into exports, and a later
+        ``register`` of the same id starts from a clean baseline."""
+        for d in (self._states, self._miss, self._seen, self._sig,
+                  self._beats, self._beat_t, self._detected_t):
+            d.pop(rid, None)
+
     # -------------------------------------------------------------- signals
 
     def beat(self, rid: int, ticks: Optional[int] = None) -> None:

@@ -51,6 +51,14 @@ class Replica:
     device state) but the router stops pumping it, so it stops beating
     and the health watchdog must detect it.  ``fail_replica`` is the
     *consequence* of a wedge, never the injection itself.
+
+    ``draining`` / ``retiring``: the scale-event window markers
+    (cluster/autoscale.py).  ``draining`` is set while the replica's
+    sequences are mid-migration (drain snapshot in flight), ``retiring``
+    while its staged ``close()`` runs; both clear when the replica
+    leaves the fleet (or rejoins a tier).  Fault killers REFUSE victims
+    inside either window (faults/supervisor.py) — a kill there would
+    orphan the drain snapshot.
     """
 
     def __init__(self, replica_id: int, backend: Any, mesh=None,
@@ -61,6 +69,8 @@ class Replica:
         self.rebuild = rebuild
         self.alive = True
         self.wedged = False
+        self.draining = False
+        self.retiring = False
 
     def wedge(self) -> None:
         """Simulate this replica's process dying: it stays nominally

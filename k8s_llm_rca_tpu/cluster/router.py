@@ -157,6 +157,82 @@ class ClusterRouter:
             if engine is not None:
                 engine._hb_stamp = True   # clock-stamp tick heartbeats
 
+    # ----------------------------------------------------- fleet membership
+
+    def add_replica(self, replica: Replica, tier: Optional[str] = None) -> None:
+        """Admit ``replica`` into the fleet (the elastic scale-up seam,
+        cluster/autoscale.py).  Validates the id is fresh and — when the
+        newcomer carries a submesh — that it is disjoint from every
+        incumbent's (an overlapping submesh would race the survivors'
+        collectives, same refusal as ``ReplicaSupervisor.bind``).  The
+        replica dict is rebuilt SORTED by id: pump iteration order is a
+        determinism surface and must not depend on admission history.
+        With a watchdog attached the newcomer is registered immediately
+        so its first probe baselines instead of missing."""
+        if tier is not None:
+            raise ValueError(
+                f"add_replica(tier={tier!r}): a plain ClusterRouter has "
+                f"no tiers — use a TierRouter (cluster/disagg.py) for "
+                f"tiered admission")
+        self._admit_replica(replica)
+
+    def _admit_replica(self, replica: Replica) -> None:
+        rid = replica.replica_id
+        if rid in self.replicas:
+            raise ValueError(
+                f"replica id {rid} is already in the fleet "
+                f"(ids: {sorted(self.replicas)})")
+        if replica.mesh is not None:
+            from k8s_llm_rca_tpu.engine.engine import (
+                validate_disjoint_submeshes,
+            )
+
+            meshes = [r.mesh for r in self.replicas.values()
+                      if r.mesh is not None]
+            if meshes:
+                validate_disjoint_submeshes(meshes + [replica.mesh])
+        self.replicas[rid] = replica
+        self.replicas = {r: self.replicas[r]
+                         for r in sorted(self.replicas)}
+        if self.health is not None:
+            self.health.register(rid)
+            engine = getattr(replica.backend, "engine", None)
+            if engine is not None:
+                engine._hb_stamp = True
+        log.info("replica %d admitted to the fleet (%d replicas)",
+                 rid, len(self.replicas))
+
+    def remove_replica(self, rid: int) -> Replica:
+        """Retire ``rid`` from the fleet entirely (the elastic
+        scale-down seam) and return the Replica object so the caller can
+        park it as a free submesh.  Refuses while the replica still owns
+        in-flight runs (drain or fail it over first — silently dropping
+        admitted work is the one thing the router never does) and when
+        it is the last alive replica (an outage, not a scale-down)."""
+        replica = self.replicas.get(rid)
+        if replica is None:
+            raise ValueError(
+                f"replica {rid} is not in the fleet "
+                f"(ids: {sorted(self.replicas)})")
+        orphans = self._orphans(rid)
+        if orphans:
+            raise ValueError(
+                f"refusing to remove replica {rid}: it still owns "
+                f"{len(orphans)} in-flight run(s) — drain_replica or "
+                f"fail_replica must migrate them first")
+        if replica.alive and len(self.alive_ids()) <= 1:
+            raise ValueError(
+                f"refusing to remove replica {rid}: it is the last "
+                f"alive replica (an outage, not a scale-down)")
+        del self.replicas[rid]
+        for session in [s for s, r in self._affinity.items() if r == rid]:
+            del self._affinity[session]
+        if self.health is not None:
+            self.health.unregister(rid)
+        log.info("replica %d removed from the fleet (%d replicas left)",
+                 rid, len(self.replicas))
+        return replica
+
     def _heal(self) -> None:
         """Top of every ``pump``: probe, then heal each newly-DEAD
         replica — quarantine its poison runs, fail it over (the existing

@@ -1156,3 +1156,254 @@ def run_open_loop_soak(seed: int = 0, rate_per_s: float = 200.0,
         "virtual_elapsed_s": round(clock.time(), 6),
         "fleet_alive": len(router.alive_ids()),
     }
+
+
+_METERED_ECHO_CLS = None
+
+
+def metered_echo_class():
+    """``_MeteredEcho``: an EchoBackend with FINITE per-pump service
+    capacity — it settles at most ``settle_per_pump`` ready runs per
+    pump, FIFO by handle.  The plain Echo/Oracle backends settle EVERY
+    ready run each pump (infinite parallelism), so fleet size would
+    never move time-to-report and an elastic-vs-static comparison would
+    be vacuous; metering makes queue depth the latency driver, which is
+    exactly the gauge the autoscaler watches.  Built lazily (soak
+    convention: serve-layer imports stay inside functions)."""
+    global _METERED_ECHO_CLS
+    if _METERED_ECHO_CLS is not None:
+        return _METERED_ECHO_CLS
+
+    from k8s_llm_rca_tpu.serve.backend import BackendResult, EchoBackend
+
+    class _MeteredEcho(EchoBackend):
+        def __init__(self, tokenizer, settle_per_pump: int = 1, **kw):
+            if settle_per_pump < 1:
+                raise ValueError(
+                    f"settle_per_pump must be >= 1 (a backend that "
+                    f"settles nothing never drains), got "
+                    f"{settle_per_pump}")
+            super().__init__(tokenizer, **kw)
+            self.settle_per_pump = settle_per_pump
+
+        def pump(self):
+            results = {}
+            settled = 0
+            for handle in sorted(self._inflight):
+                if settled >= self.settle_per_pump:
+                    break
+                prompt, opts, remaining = self._inflight[handle]
+                if remaining > 0:
+                    self._inflight[handle] = (prompt, opts, remaining - 1)
+                    continue
+                del self._inflight[handle]
+                if self.fail:
+                    results[handle] = BackendResult(
+                        "", 0, error="echo backend failure")
+                    settled += 1
+                    continue
+                text = (self.reply if self.reply is not None
+                        else f"echo: {prompt[-64:]}")
+                text = opts.forced_prefix + text + opts.suffix
+                results[handle] = BackendResult(
+                    text=text,
+                    completion_tokens=self.tokenizer.count(text))
+                settled += 1
+            return results
+
+    _METERED_ECHO_CLS = _MeteredEcho
+    return _MeteredEcho
+
+
+def diurnal_arrivals(seed: int, rate_low_per_s: float,
+                     rate_high_per_s: float, period_s: float,
+                     n: int) -> List[float]:
+    """Seeded non-homogeneous Poisson arrivals under a sinusoidal
+    diurnal rate ramp: rate(t) = low + (high - low)·(1 - cos(2πt/T))/2
+    — the night trough at t=0, the midday peak at t=T/2.  Sampled by
+    thinning against the ``rate_high_per_s`` majorant, so it is a pure
+    function of ``(seed, rates, period, n)`` on the stdlib Mersenne
+    generator (byte-stable across hosts, like ``poisson_arrivals``)."""
+    if rate_low_per_s <= 0.0 or rate_high_per_s < rate_low_per_s:
+        raise ValueError(
+            f"need 0 < rate_low_per_s <= rate_high_per_s, got "
+            f"low={rate_low_per_s}, high={rate_high_per_s}")
+    if period_s <= 0.0:
+        raise ValueError(f"period_s must be > 0, got {period_s}")
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    import math
+    import random
+
+    rng = random.Random(seed)
+    t, out = 0.0, []
+    while len(out) < n:
+        t += rng.expovariate(rate_high_per_s)
+        lam = rate_low_per_s + (rate_high_per_s - rate_low_per_s) * 0.5 \
+            * (1.0 - math.cos(2.0 * math.pi * t / period_s))
+        if rng.random() * rate_high_per_s <= lam:
+            out.append(round(t, 9))
+    return out
+
+
+def run_elastic_soak(seed: int = 0, rate_low_per_s: float = 60.0,
+                     rate_high_per_s: float = 1500.0,
+                     period_s: float = 0.6, n_runs: int = 520,
+                     n_min: int = 1, n_max: int = 4,
+                     elastic: bool = True,
+                     policy: Optional[Any] = None,
+                     killer: Optional[Any] = None,
+                     settle_per_pump: int = 1,
+                     run_timeout_s: float = 30.0,
+                     tick_s: float = 0.005) -> Dict[str, Any]:
+    """Open-loop diurnal-ramp soak over an ELASTIC fleet — the
+    acceptance surface of the autoscaler (cluster/autoscale.py):
+
+    - ``elastic=True``: the router starts with ``n_min`` metered-echo
+      replicas; the remaining ``n_max - n_min`` are parked on the
+      Autoscaler's reserve (free submeshes).  ``evaluate()`` runs once
+      per idle loop iteration, so the fleet grows into the ramp and
+      drains back down the far side.
+    - ``elastic=False``: the static twin — all ``n_max`` replicas
+      serve from t=0, no autoscaler.
+
+    Both modes integrate ``chip_seconds`` identically (alive replicas ×
+    every virtual-clock advance), so the bar "elastic p99 time-to-report
+    <= static with strictly fewer chip-seconds" compares like with like.
+    ``killer`` is polled once per ARRIVAL (run_open_loop_soak
+    discipline) — with killers armed DURING scale events the report must
+    still come out byte-identical run over run: scale/kill/heal stats
+    live on the autoscaler/killer/router objects, never in the report.
+
+    Returns ``{"report": ..., "stats": ...}`` — byte-identity is
+    ``report_bytes(out["report"])``; ``stats`` carries the scale/kill
+    counters (deterministic too, but harness-side by convention).
+    """
+    if not 1 <= n_min < n_max:
+        raise ValueError(
+            f"need 1 <= n_min < n_max (an elastic band), got "
+            f"n_min={n_min}, n_max={n_max}")
+    clock = VirtualClock()
+    from k8s_llm_rca_tpu.cluster import (ClusterRouter, HealthWatchdog,
+                                         Replica, ReplicaSupervisor)
+    from k8s_llm_rca_tpu.cluster.autoscale import Autoscaler, ScalePolicy
+    from k8s_llm_rca_tpu.serve.api import AssistantService, RunStatus
+    from k8s_llm_rca_tpu.serve.backend import GenOptions
+    from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+    cls = metered_echo_class()
+    tok = get_tokenizer()
+    replicas = [
+        Replica(i, cls(tok, settle_per_pump),
+                rebuild=lambda t=tok, c=cls, k=settle_per_pump: c(t, k))
+        for i in range(n_max)]
+    router = ClusterRouter(replicas[:n_min] if elastic else replicas)
+    router.attach_health(HealthWatchdog(None, clock=clock),
+                         ReplicaSupervisor())
+    scaler = None
+    if elastic:
+        pol = policy or ScalePolicy(
+            high_water=0.5, low_water=0.15, depth_capacity=2,
+            sustain_ticks=2, cooldown_ticks=2,
+            min_replicas=n_min, max_replicas=n_max)
+        scaler = Autoscaler(router, pol, reserve=replicas[n_min:],
+                            clock=clock)
+    if killer is not None:
+        killer.router = router
+    service = AssistantService(router, run_timeout_s=run_timeout_s,
+                               clock=clock)
+    asst = service.create_assistant(
+        "You are an SRE root-cause analyst.", "elastic",
+        gen=GenOptions(max_new_tokens=16))
+    arrivals = diurnal_arrivals(seed, rate_low_per_s, rate_high_per_s,
+                                period_s, n_runs)
+    from k8s_llm_rca_tpu.graph.fixtures import INCIDENTS
+
+    pending = list(enumerate(arrivals))
+    live: Dict[str, tuple] = {}               # run id -> (i, arrival_t)
+    rows: List[Dict[str, Any]] = []
+    chip_seconds = 0.0
+
+    def _advance(dt: float) -> None:
+        # chips burn whenever virtual time passes, busy or idle — the
+        # like-with-like integral both fleet modes share
+        nonlocal chip_seconds
+        if dt <= 0.0:
+            return
+        chip_seconds += len(router.alive_ids()) * dt
+        clock.sleep(dt)
+
+    while pending or live:
+        now = clock.time()
+        if pending and pending[0][1] <= now:
+            i, t_arr = pending.pop(0)
+            thread = service.create_thread()
+            service.add_message(
+                thread.id, INCIDENTS[i % len(INCIDENTS)].message)
+            run = service.create_run(thread.id, asst.id)
+            live[run.id] = (i, t_arr)
+            if killer is not None:
+                killer.checkpoint()     # arrival-boundary discipline
+            continue
+        if pending and not live:
+            if scaler is not None:
+                scaler.evaluate()       # troughs are where drain-down
+            _advance(max(tick_s, pending[0][1] - now))  # fires; idle jump
+            continue
+        # one service tick: the pump COSTS tick_s of virtual time BEFORE
+        # results land, so a replica serves settle_per_pump/tick_s runs
+        # per second — finite service capacity is what lets the diurnal
+        # peak build the queue the autoscaler watches (a free pump would
+        # model an infinitely fast server and the elastic-vs-static
+        # comparison would be vacuous)
+        if scaler is not None:
+            scaler.evaluate()           # one control tick per loop tick
+        _advance(tick_s)
+        service._pump()
+        now = clock.time()
+        for run_id in [r for r in live
+                       if service.runs[r].status in RunStatus.TERMINAL]:
+            i, t_arr = live.pop(run_id)
+            run = service.runs[run_id]
+            rows.append({"i": i, "status": run.status,
+                         "ttr_s": round(now - t_arr, 9)})
+    if router.health is not None:
+        budget = router.health.policy.hung_tick_threshold + 2
+        for _ in range(budget):          # heal a storm-tail wedge
+            if all(r.healthy() for r in router.replicas.values()):
+                break
+            router.pump()
+    rows.sort(key=lambda r: r["i"])
+    ttrs = sorted(r["ttr_s"] for r in rows)
+
+    def _pct(q: float) -> Optional[float]:
+        if not ttrs:
+            return None
+        return round(ttrs[min(len(ttrs) - 1, int(q * len(ttrs)))], 9)
+
+    report = {
+        "seed": seed, "rate_low_per_s": rate_low_per_s,
+        "rate_high_per_s": rate_high_per_s, "period_s": period_s,
+        "n_runs": n_runs, "n_min": n_min, "n_max": n_max,
+        "elastic": bool(elastic), "settle_per_pump": settle_per_pump,
+        "outcomes": rows,
+        "completed": sum(1 for r in rows
+                         if r["status"] == RunStatus.COMPLETED),
+        "failed": sum(1 for r in rows
+                      if r["status"] == RunStatus.FAILED),
+        "p50_ttr_s": _pct(0.50),
+        "p99_ttr_s": _pct(0.99),
+        "chip_seconds": round(chip_seconds, 9),
+        "virtual_elapsed_s": round(clock.time(), 6),
+        "fleet_alive": len(router.alive_ids()),
+    }
+    stats = {
+        "scale_ups": scaler.scale_ups if scaler else 0,
+        "scale_downs": scaler.scale_downs if scaler else 0,
+        "rebalances": scaler.rebalances if scaler else 0,
+        "decisions": len(scaler.decisions) if scaler else 0,
+        "reserve_free": len(scaler.reserve) if scaler else 0,
+        "kills": len(killer.kills) if killer is not None else 0,
+    }
+    return {"report": report, "stats": stats, "router": router,
+            "autoscaler": scaler}

@@ -190,11 +190,32 @@ class ReplicaKiller:
         self.mode = mode
         self.kills: List[int] = []
 
+    def _refuse_mid_scale(self, victim: int, replica) -> None:
+        """Refuse a victim inside the drain/retire window
+        (cluster/autoscale.py scale events set ``Replica.draining`` /
+        ``Replica.retiring``): a kill there would orphan the drain
+        snapshot mid-migration — its pinned sequences belong to neither
+        side — which is a plan bug, not a chaos scenario."""
+        draining = getattr(replica, "draining", False)
+        retiring = getattr(replica, "retiring", False)
+        if draining or retiring:
+            b_kind = getattr(replica.backend, "kind",
+                             type(replica.backend).__name__)
+            b_transport = getattr(replica.backend, "transport_kind",
+                                  "in-process")
+            raise ValueError(
+                f"{type(self).__name__} refuses replica {victim} "
+                f"(kind={b_kind!r}, transport={b_transport!r}): it is "
+                f"mid-{'drain' if draining else 'retire'} — a kill "
+                f"inside the scale-event window would orphan the drain "
+                f"snapshot; schedule the kill outside scale events")
+
     def _kill(self, victim: int, mode: Optional[str] = None) -> None:
         """Deliver the kill per ``mode`` (defaults to ``self.mode``;
         victim already chosen, last-alive policy already applied in
         ``checkpoint``)."""
         replica = self.router.replicas[victim]
+        self._refuse_mid_scale(victim, replica)
         is_proc = hasattr(replica, "kill_process")
         health = getattr(self.router, "health", None)
         # name the victim precisely in refusals: its worker kind and
@@ -422,6 +443,9 @@ class HandoffKiller(ReplicaKiller):
                         "and no restart-enabled supervisor", len(alive))
             return None
         replica = self.router.replicas[victim]
+        # the proc-sigkill path below bypasses _kill, so the mid-drain/
+        # mid-retire refusal must be applied here as well
+        self._refuse_mid_scale(victim, replica)
         if mode == "sigkill":
             if not hasattr(replica, "kill_process"):
                 # in-process tier member: no OS process to SIGKILL —

@@ -144,6 +144,29 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                            "tid": ev.tid,
                            "name": "cluster.hard_detections",
                            "args": {k: hard[k] for k in sorted(hard)}})
+        # elastic-fleet counter tracks, synthesized from the autoscaler's
+        # cluster.scale events (cluster/autoscale.py _record): one "C"
+        # sample per scale action — running count per kind
+        # (up/down/rebalance) plus the fleet size the action left behind,
+        # so Perfetto shows the fleet breathing with the diurnal ramp —
+        # mirror of the cluster_scale_events_total{kind=} /
+        # cluster_fleet_size{tier=} Prometheus families
+        scale: Dict[str, int] = {}
+        for ev in tracer.events:
+            if ev.name != "cluster.scale":
+                continue
+            kind = str(ev.args.get("kind", "up"))
+            scale[kind] = scale.get(kind, 0) + 1
+            events.append({"ph": "C", "ts": _us(ev.ts), "pid": 1,
+                           "tid": ev.tid,
+                           "name": "cluster.scale_events",
+                           "args": {k: scale[k] for k in sorted(scale)}})
+            if ev.args.get("fleet") is not None:
+                events.append({"ph": "C", "ts": _us(ev.ts), "pid": 1,
+                               "tid": ev.tid,
+                               "name": "cluster.fleet_size",
+                               "args": {"alive":
+                                        int(ev.args["fleet"])}})
     # stable sort: equal-ts events keep recording order, so the document
     # is a pure function of the recording (byte-identity under VirtualClock)
     events.sort(key=lambda e: e["ts"])
@@ -416,6 +439,29 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
                     "by evidence kind (proc/link/handoff)")
                 for kind in sorted(kinds):
                     fam_hd.add(kinds[kind], labels=f'{{kind="{kind}"}}')
+        # elastic fleet (cluster/autoscale.py): per-tier fleet size and
+        # scale-event counters, read from the router's autoscaler
+        # backref; plain ClusterRouter fleets render as tier="all"
+        scaler = getattr(router, "autoscaler", None)
+        if scaler is not None:
+            sizes = scaler.fleet_sizes()
+            fam_fs = family(
+                f"{_PREFIX}cluster_fleet_size", "gauge",
+                "alive replicas per tier under the elastic autoscaler "
+                '(tier="all" on an untiered router)')
+            for tier in sorted(sizes):
+                fam_fs.add(sizes[tier], labels=f'{{tier="{tier}"}}')
+            scale_counts = {"up": scaler.scale_ups,
+                            "down": scaler.scale_downs,
+                            "rebalance": scaler.rebalances}
+            if any(scale_counts.values()):
+                fam_sc = family(
+                    f"{_PREFIX}cluster_scale_events_total", "counter",
+                    "autoscaler actions by kind (up/down/rebalance)")
+                for kind in sorted(scale_counts):
+                    if scale_counts[kind]:
+                        fam_sc.add(scale_counts[kind],
+                                   labels=f'{{kind="{kind}"}}')
 
     return "\n".join(families[n].render()
                      for n in sorted(families)) + "\n"

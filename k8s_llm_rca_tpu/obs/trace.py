@@ -107,6 +107,10 @@ SITES = frozenset({
     # outcome — a committed EXPORT -> ADOPT -> RELEASE transfer, or a
     # retried attempt discarded whole (args carry the stage and reason)
     "cluster.handoff",
+    # elastic fleet (cluster/autoscale.py): one event per autoscaler
+    # action — scale-up spawn, drain-down retirement, or tier rebalance
+    # (args carry kind/tier/replica/fleet size/free submeshes)
+    "cluster.scale",
     # graph layer
     "graph.query",
     # rca pipeline stages

@@ -1,0 +1,569 @@
+"""Elastic fleet autoscaler tests (cluster/autoscale.py).
+
+Layers, cheapest first:
+
+- **ScalePolicy / constructor exclusions**: every watermark, hysteresis
+  and cooldown nonsense value raises a loud ValueError, as do fleets
+  without a health watchdog or restart-enabled supervisor and reserves
+  with missing rebuild recipes or colliding ids.
+- **decision sequences** (frozen VirtualClock, scripted replicas): the
+  exact ``decisions`` list for a scripted gauge history — sustain
+  thresholds, the hysteresis dead band, cooldown sit-outs, and the
+  at-most-one-action-per-tick rule.
+- **actuators**: scale-up spawns through the supervisor rebuild-recipe
+  path onto a reserve submesh (loud refusal when none is free or the
+  fleet is at max_replicas); scale-down drains the least-loaded worker
+  (live runs migrate by deterministic re-start), retires it through
+  ``close()`` and parks the submesh back on the reserve; rebalance
+  moves a worker between TierRouter tiers via ``reassign_tier`` with
+  settled-text byte parity against static tiers.
+- **killer shield**: ReplicaKiller/HandoffKiller refuse (naming the
+  victim) to target a worker mid-drain or mid-retire.
+- **membership exclusions**: add_replica/remove_replica/reassign_tier
+  refuse duplicate ids, in-flight removals, last-alive removals, tier
+  emptying, seam mismatches and phase flips with queued work.
+- **elastic soak** (faults/soak.py): ``run_elastic_soak`` is
+  byte-deterministic, the chaos variant with killers armed DURING
+  scale events settles byte-identical twice over, and (slow) the
+  diurnal-ramp acceptance bar — elastic p99 time-to-report <= static
+  with strictly fewer chip-seconds.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from k8s_llm_rca_tpu.cluster import (
+    Autoscaler, HealthPolicy, HealthWatchdog, Replica, ReplicaSupervisor,
+    ScalePolicy, TierRouter, TIER_DECODE, TIER_PREFILL, ClusterRouter,
+)
+from k8s_llm_rca_tpu.faults import inject
+from k8s_llm_rca_tpu.faults.plan import Fault, FaultPlan, VirtualClock
+from k8s_llm_rca_tpu.faults.soak import (
+    diurnal_arrivals, metered_echo_class, report_bytes, run_elastic_soak,
+)
+from k8s_llm_rca_tpu.faults.supervisor import HandoffKiller, ReplicaKiller
+from k8s_llm_rca_tpu.serve.backend import EchoBackend, GenOptions
+from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+pytestmark = pytest.mark.autoscale
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return get_tokenizer()
+
+
+def _mk(rid, tok, settle_per_pump=1):
+    cls = metered_echo_class()
+    return Replica(rid, cls(tok, settle_per_pump),
+                   rebuild=lambda: cls(tok, settle_per_pump))
+
+
+def _fleet(n_active, n_reserve, tok, policy=None, clock=None, **kw):
+    """Plain elastic fleet: ``n_active`` metered-echo replicas serving,
+    ``n_reserve`` parked on the autoscaler's reserve."""
+    clock = clock if clock is not None else VirtualClock()
+    replicas = [_mk(i, tok) for i in range(n_active + n_reserve)]
+    router = ClusterRouter(replicas[:n_active])
+    router.attach_health(HealthWatchdog(None, clock=clock),
+                         ReplicaSupervisor())
+    scaler = Autoscaler(router, policy, reserve=replicas[n_active:],
+                        clock=clock, **kw)
+    return router, scaler, clock
+
+
+def _tier_fleet(n_prefill, n_decode, tok, policy=None, reserve=0):
+    clock = VirtualClock()
+    mk = lambda i: _mk(i, tok)                              # noqa: E731
+    router = TierRouter([mk(i) for i in range(n_prefill)],
+                        [mk(n_prefill + i) for i in range(n_decode)])
+    router.attach_health(HealthWatchdog(None, clock=clock),
+                         ReplicaSupervisor())
+    parked = [mk(n_prefill + n_decode + i) for i in range(reserve)]
+    scaler = Autoscaler(router, policy, reserve=parked, clock=clock)
+    return router, scaler, clock
+
+
+def _settle(router, handles, pumps=64):
+    out = {}
+    for _ in range(pumps):
+        out.update(router.pump())
+        if all(h in out for h in handles):
+            return out
+    raise AssertionError(f"runs never settled: {sorted(out)}")
+
+
+# ---------------------------------------------------------------------------
+# ScalePolicy / constructor exclusions
+# ---------------------------------------------------------------------------
+
+
+class TestScalePolicy:
+
+    @pytest.mark.parametrize("kw,msg", [
+        (dict(high_water=0.0), "high_water must be positive"),
+        (dict(high_water=-1.0), "high_water must be positive"),
+        (dict(low_water=-0.1), "hysteresis band"),
+        (dict(low_water=0.8, high_water=0.8), "hysteresis band"),
+        (dict(low_water=0.9, high_water=0.8), "hysteresis band"),
+        (dict(depth_capacity=0), "depth_capacity must be >= 1"),
+        (dict(sustain_ticks=0), "sustain_ticks must be >= 1"),
+        (dict(cooldown_ticks=-1), "cooldown_ticks must be >= 0"),
+        (dict(min_replicas=0), "min_replicas must be >= 1"),
+        (dict(min_replicas=2, max_replicas=2),
+         "max_replicas must exceed min_replicas"),
+        (dict(min_replicas=4, max_replicas=2),
+         "max_replicas must exceed min_replicas"),
+        (dict(rebalance_band=0.0), "rebalance_band must sit in"),
+        (dict(rebalance_band=1.0), "rebalance_band must sit in"),
+        (dict(rebalance_sustain_ticks=0),
+         "rebalance_sustain_ticks must be >= 1"),
+    ])
+    def test_loud_validation(self, kw, msg):
+        with pytest.raises(ValueError, match=msg):
+            ScalePolicy(**kw)
+
+    def test_defaults_are_valid(self):
+        pol = ScalePolicy()
+        assert pol.low_water < pol.high_water
+
+    def test_requires_health_watchdog(self, tok):
+        router = ClusterRouter([_mk(0, tok)])
+        with pytest.raises(ValueError, match="health-attached router"):
+            Autoscaler(router)
+
+    def test_requires_restart_enabled_supervisor(self, tok):
+        router = ClusterRouter([_mk(0, tok), _mk(1, tok)])
+        router.attach_health(HealthWatchdog(None, clock=VirtualClock()),
+                             ReplicaSupervisor(restart=False))
+        with pytest.raises(ValueError, match="restart-enabled"):
+            Autoscaler(router)
+
+    def test_reserve_needs_rebuild_recipe(self, tok):
+        cls = metered_echo_class()
+        router = ClusterRouter([_mk(0, tok)])
+        router.attach_health(HealthWatchdog(None, clock=VirtualClock()),
+                             ReplicaSupervisor())
+        bare = Replica(1, cls(tok, 1))          # no rebuild recipe
+        with pytest.raises(ValueError, match="no rebuild recipe"):
+            Autoscaler(router, reserve=[bare])
+
+    def test_reserve_id_collision(self, tok):
+        router = ClusterRouter([_mk(0, tok)])
+        router.attach_health(HealthWatchdog(None, clock=VirtualClock()),
+                             ReplicaSupervisor())
+        with pytest.raises(ValueError, match="collides"):
+            Autoscaler(router, reserve=[_mk(0, tok)])
+
+    def test_reserve_is_parked_not_alive(self, tok):
+        _, scaler, _ = _fleet(1, 2, tok)
+        assert [r.replica_id for r in scaler.reserve] == [1, 2]
+        assert all(not r.alive for r in scaler.reserve)
+
+
+# ---------------------------------------------------------------------------
+# decision sequences under a frozen VirtualClock
+# ---------------------------------------------------------------------------
+
+
+class TestDecisionSequence:
+
+    def test_scale_up_needs_sustained_high_water(self, tok):
+        pol = ScalePolicy(high_water=0.75, low_water=0.1,
+                          depth_capacity=2, sustain_ticks=3,
+                          cooldown_ticks=0, max_replicas=4)
+        router, scaler, _ = _fleet(1, 1, tok, pol)
+        opts = GenOptions(max_new_tokens=4)
+        for i in range(6):                      # depth 6 on replica 0
+            router.start(f"incident {i}", opts)
+        assert scaler.evaluate() is None        # tick 1: over = 1
+        assert scaler.evaluate() is None        # tick 2: over = 2
+        d = scaler.evaluate()                   # tick 3: over = 3 -> up
+        assert d == {"tick": 3, "kind": "up", "tier": "all",
+                     "replica": 1, "fleet": 2}
+        assert scaler.scale_ups == 1
+        assert router.replicas[1].alive
+        assert router.supervisor.incarnations[1] == 1   # rebuild spawn
+        assert scaler.reserve == []
+
+    def test_one_noisy_sample_does_not_flap(self, tok):
+        pol = ScalePolicy(high_water=0.75, low_water=0.1,
+                          depth_capacity=2, sustain_ticks=2,
+                          cooldown_ticks=0)
+        router, scaler, _ = _fleet(1, 1, tok, pol)
+        opts = GenOptions(max_new_tokens=4)
+        handles = [router.start(f"i{i}", opts) for i in range(4)]
+        assert scaler.evaluate() is None        # over = 1
+        _settle(router, handles)                # gauge falls back to 0...
+        router.start("keepalive", opts)         # ...well inside the band
+        assert scaler.evaluate() is None        # over RESET, under = 0
+        assert scaler.evaluate() is None
+        assert scaler.decisions == []
+
+    def test_hysteresis_dead_band_takes_no_action(self, tok):
+        pol = ScalePolicy(high_water=2.0, low_water=0.1,
+                          depth_capacity=2, sustain_ticks=1,
+                          cooldown_ticks=0)
+        router, scaler, _ = _fleet(1, 1, tok, pol)
+        router.start("inside the band", GenOptions(max_new_tokens=4))
+        for _ in range(5):                      # load = 0.5: low < 0.5 < high
+            assert scaler.evaluate() is None
+        assert scaler.decisions == []
+
+    def test_cooldown_pauses_actions_not_counters(self, tok):
+        pol = ScalePolicy(high_water=0.75, low_water=0.1,
+                          depth_capacity=2, sustain_ticks=1,
+                          cooldown_ticks=2, max_replicas=4)
+        router, scaler, _ = _fleet(1, 3, tok, pol)
+        opts = GenOptions(max_new_tokens=4)
+        for i in range(12):
+            router.start(f"i{i}", opts)
+        d1 = scaler.evaluate()                  # tick 1: up
+        assert d1["kind"] == "up" and d1["tick"] == 1
+        assert scaler.evaluate() is None        # tick 2: cooldown
+        assert scaler.evaluate() is None        # tick 3: cooldown
+        d2 = scaler.evaluate()                  # tick 4: up again
+        assert d2["kind"] == "up" and d2["tick"] == 4
+        assert [d["replica"] for d in scaler.decisions] == [1, 2]
+
+    def test_scale_down_after_sustained_idle(self, tok):
+        pol = ScalePolicy(high_water=0.75, low_water=0.25,
+                          depth_capacity=2, sustain_ticks=2,
+                          cooldown_ticks=0, min_replicas=1)
+        router, scaler, _ = _fleet(2, 0, tok, pol)
+        assert scaler.evaluate() is None        # under = 1
+        d = scaler.evaluate()                   # under = 2 -> down
+        assert d["kind"] == "down" and d["replica"] == 0
+        assert d["migrated"] == 0
+        assert sorted(router.replicas) == [1]
+        # the retired worker is parked back on the reserve: submesh freed
+        assert [r.replica_id for r in scaler.reserve] == [0]
+        assert not scaler.reserve[0].alive
+        # floor: the survivor is the last one, never retired
+        assert scaler.evaluate() is None
+        assert scaler.evaluate() is None
+        assert len(router.replicas) == 1
+
+    def test_evaluate_waits_instead_of_raising_at_capacity(self, tok):
+        pol = ScalePolicy(high_water=0.5, low_water=0.1,
+                          depth_capacity=1, sustain_ticks=1,
+                          cooldown_ticks=0)
+        router, scaler, _ = _fleet(1, 0, tok, pol)   # empty reserve
+        for i in range(4):
+            router.start(f"i{i}", GenOptions(max_new_tokens=4))
+        for _ in range(3):
+            assert scaler.evaluate() is None    # hot, but nothing to spawn
+        assert scaler.decisions == []
+
+
+# ---------------------------------------------------------------------------
+# actuators: refusals and live-run migration
+# ---------------------------------------------------------------------------
+
+
+class TestActuators:
+
+    def test_scale_up_refuses_empty_reserve(self, tok):
+        router, scaler, _ = _fleet(1, 0, tok)
+        with pytest.raises(ValueError, match="no free submesh"):
+            scaler.scale_up()
+
+    def test_scale_up_refuses_past_max_replicas(self, tok):
+        pol = ScalePolicy(min_replicas=1, max_replicas=2)
+        router, scaler, _ = _fleet(2, 1, tok, pol)
+        with pytest.raises(ValueError, match="max_replicas"):
+            scaler.scale_up()
+
+    def test_scale_down_refuses_min_replicas_floor(self, tok):
+        pol = ScalePolicy(min_replicas=2, max_replicas=4)
+        router, scaler, _ = _fleet(2, 0, tok, pol)
+        with pytest.raises(ValueError, match="min_replicas"):
+            scaler.scale_down()
+
+    def test_scale_down_migrates_live_runs(self, tok):
+        router, scaler, _ = _fleet(2, 0, tok)
+        opts = GenOptions(max_new_tokens=4)
+        handles = [router.start(f"incident {i}", opts) for i in range(6)]
+        victim = min(router.replicas,
+                     key=lambda r: (router.replicas[r].queue_depth(), r))
+        d = scaler.scale_down()
+        assert d["replica"] == victim
+        assert d["migrated"] > 0                # live runs moved, not lost
+        assert router.migrated_runs == d["migrated"]
+        survivor = [r for r in (0, 1) if r != victim][0]
+        assert sorted(router.replicas) == [survivor]
+        out = _settle(router, handles)
+        assert len(out) == 6
+        assert all(res.error is None for res in out.values())
+
+    def test_tiered_scale_up_requires_tier(self, tok):
+        router, scaler, _ = _tier_fleet(1, 1, tok, reserve=1)
+        with pytest.raises(ValueError, match="needs the tier"):
+            scaler.scale_up()
+        d = scaler.scale_up(TIER_DECODE)
+        assert d["tier"] == TIER_DECODE
+        assert router.decode_ids == [1, 2]
+
+    def test_tiered_scale_down_keeps_last_member(self, tok):
+        pol = ScalePolicy(min_replicas=1, max_replicas=8)
+        router, scaler, _ = _tier_fleet(1, 2, tok, pol)
+        with pytest.raises(ValueError, match="last healthy"):
+            scaler.scale_down(TIER_PREFILL)
+        d = scaler.scale_down(TIER_DECODE)      # 2 members: allowed
+        assert d["tier"] == TIER_DECODE
+        assert len(router.decode_ids) == 1
+
+
+# ---------------------------------------------------------------------------
+# tier rebalance: decision flow + settled-text byte parity
+# ---------------------------------------------------------------------------
+
+
+class TestRebalance:
+
+    def test_rebalance_requires_tier_router(self, tok):
+        _, scaler, _ = _fleet(2, 0, tok)
+        with pytest.raises(ValueError, match="needs a TierRouter"):
+            scaler.rebalance(TIER_PREFILL, TIER_DECODE)
+
+    def test_rebalance_keeps_one_fat_member(self, tok):
+        _, scaler, _ = _tier_fleet(1, 1, tok)
+        with pytest.raises(ValueError, match="must keep one"):
+            scaler.rebalance(TIER_PREFILL, TIER_DECODE)
+
+    def _run(self, tok, rebalance):
+        """Decode-heavy phase mix on 3P+2D scripted tiers: prefill hands
+        off instantly, metered decode queues build, so the hot tier is
+        decode and the fat tier is prefill."""
+        clock = VirtualClock()
+        router = TierRouter([_mk(i, tok) for i in range(3)],
+                            [_mk(3 + i, tok) for i in range(2)])
+        router.attach_health(HealthWatchdog(None, clock=clock),
+                             ReplicaSupervisor())
+        scaler = None
+        if rebalance:
+            pol = ScalePolicy(high_water=9.0, low_water=0.01,
+                              depth_capacity=2, sustain_ticks=99,
+                              cooldown_ticks=1, rebalance_band=0.5,
+                              rebalance_sustain_ticks=2,
+                              min_replicas=1, max_replicas=8)
+            scaler = Autoscaler(router, pol, clock=clock)
+        opts = GenOptions(max_new_tokens=8)
+        handles = [router.start(f"incident {i}: pod crashloop", opts)
+                   for i in range(12)]
+        texts = {}
+        for _ in range(60):
+            if scaler is not None:
+                scaler.evaluate()
+            for h, res in router.pump().items():
+                texts[h] = res.text
+            clock.sleep(0.01)
+            if len(texts) == len(handles):
+                break
+        return texts, router, scaler
+
+    def test_phase_mix_shift_rebalances_with_byte_parity(self, tok):
+        elastic, router, scaler = self._run(tok, rebalance=True)
+        static, _, _ = self._run(tok, rebalance=False)
+        assert scaler.rebalances >= 1
+        kinds = [d["kind"] for d in scaler.decisions]
+        assert set(kinds) == {"rebalance"}
+        first = scaler.decisions[0]
+        assert first["src_tier"] == TIER_PREFILL
+        assert first["tier"] == TIER_DECODE
+        # the mover changed phase for real
+        assert first["replica"] in router.decode_ids
+        assert first["replica"] not in router.prefill_ids
+        # no in-flight run lost: settled texts byte-identical to the
+        # static-tier twin
+        b_e = json.dumps(elastic, sort_keys=True).encode()
+        b_s = json.dumps(static, sort_keys=True).encode()
+        assert b_e == b_s
+        assert len(elastic) == 12
+
+
+# ---------------------------------------------------------------------------
+# killer shield: no kills inside the drain/retire window
+# ---------------------------------------------------------------------------
+
+
+class TestKillerShield:
+
+    def _armed_killer(self, router):
+        plan = FaultPlan([Fault(inject.SITE_REPLICA, 0, "crash")])
+        return ReplicaKiller(plan, router=router, mode="auto")
+
+    def test_replica_killer_refuses_mid_drain(self, tok):
+        router, scaler, _ = _fleet(2, 0, tok)
+        router.replicas[0].draining = True
+        killer = self._armed_killer(router)
+        with pytest.raises(ValueError, match=r"replica 0 .* mid-drain"):
+            killer.checkpoint()
+
+    def test_replica_killer_refuses_mid_retire(self, tok):
+        router, scaler, _ = _fleet(2, 0, tok)
+        router.replicas[0].retiring = True
+        killer = self._armed_killer(router)
+        with pytest.raises(ValueError, match=r"replica 0 .* mid-retire"):
+            killer.checkpoint()
+
+    def test_refusal_names_killer_and_victim(self, tok):
+        router, scaler, _ = _fleet(2, 0, tok)
+        router.replicas[0].draining = True
+        killer = self._armed_killer(router)
+        with pytest.raises(ValueError, match="ReplicaKiller"):
+            killer.checkpoint()
+
+    def test_handoff_killer_refuses_mid_drain_source(self, tok):
+        router, scaler, _ = _tier_fleet(1, 1, tok)
+        plan = FaultPlan([Fault(inject.SITE_HANDOFF, 0, "crash")])
+        killer = HandoffKiller(plan, router=router, target="prefill")
+        router.replicas[0].draining = True
+        with pytest.raises(ValueError,
+                           match=r"HandoffKiller refuses replica 0"):
+            killer.window(router, ghandle=1, src_rid=0, dst_rid=1)
+
+    def test_clean_replica_still_killable(self, tok):
+        router, scaler, _ = _fleet(2, 0, tok)
+        killer = self._armed_killer(router)
+        victim = killer.checkpoint()            # nothing mid-scale
+        assert victim == 0
+        assert router.replicas[0].wedged
+
+
+# ---------------------------------------------------------------------------
+# fleet membership exclusions (router/disagg seams the autoscaler drives)
+# ---------------------------------------------------------------------------
+
+
+class TestMembershipExclusions:
+
+    def test_add_replica_refuses_duplicate_id(self, tok):
+        router, _, _ = _fleet(2, 0, tok)
+        with pytest.raises(ValueError, match="already in the fleet"):
+            router.add_replica(_mk(1, tok))
+
+    def test_plain_router_refuses_tier_argument(self, tok):
+        router, _, _ = _fleet(1, 0, tok)
+        with pytest.raises(ValueError, match="has no tiers"):
+            router.add_replica(_mk(1, tok), tier=TIER_PREFILL)
+
+    def test_remove_replica_refuses_inflight(self, tok):
+        router, _, _ = _fleet(2, 0, tok)
+        h = router.start("live run", GenOptions(max_new_tokens=4))
+        rid = router._handle_map[h][0]
+        with pytest.raises(ValueError, match="in-flight"):
+            router.remove_replica(rid)
+
+    def test_remove_replica_refuses_last_alive(self, tok):
+        router, _, _ = _fleet(1, 0, tok)
+        with pytest.raises(ValueError, match="outage, not a scale-down"):
+            router.remove_replica(0)
+
+    def test_tier_add_requires_valid_tier(self, tok):
+        router, _, _ = _tier_fleet(1, 1, tok)
+        with pytest.raises(ValueError, match="tier"):
+            router.add_replica(_mk(7, tok), tier=None)
+
+    def test_tier_add_refuses_seam_mismatch(self, tok):
+        router, _, _ = _tier_fleet(1, 1, tok)
+
+        class _FakeSeam(EchoBackend):
+            def export_run(self, *a, **kw):     # engine-seam marker
+                raise NotImplementedError
+
+        seam = Replica(9, _FakeSeam(tok), rebuild=lambda: _FakeSeam(tok))
+        with pytest.raises(ValueError, match="seam"):
+            router.add_replica(seam, tier=TIER_DECODE)
+
+    def test_tier_remove_refuses_emptying_tier(self, tok):
+        router, _, _ = _tier_fleet(1, 2, tok)
+        with pytest.raises(ValueError, match="empty tier cannot serve"):
+            router.remove_replica(0)
+
+    def test_reassign_refuses_inflight_phase_flip(self, tok):
+        router, _, _ = _tier_fleet(2, 1, tok)
+        h = router.start("queued", GenOptions(max_new_tokens=4))
+        rid = router._handle_map[h][0]
+        with pytest.raises(ValueError, match="drain it first"):
+            router.reassign_tier(rid, TIER_DECODE)
+
+    def test_reassign_refuses_emptying_donor(self, tok):
+        router, _, _ = _tier_fleet(1, 2, tok)
+        with pytest.raises(ValueError, match="last"):
+            router.reassign_tier(0, TIER_DECODE)
+
+
+# ---------------------------------------------------------------------------
+# elastic soak: determinism, chaos-during-scale, the acceptance bar
+# ---------------------------------------------------------------------------
+
+_FAST_SOAK = dict(seed=0, rate_low_per_s=60.0, rate_high_per_s=800.0,
+                  period_s=0.3, n_runs=96)
+
+
+class TestElasticSoak:
+
+    def test_diurnal_arrivals_deterministic_and_monotone(self):
+        a = diurnal_arrivals(7, 50.0, 500.0, 1.0, 64)
+        b = diurnal_arrivals(7, 50.0, 500.0, 1.0, 64)
+        assert a == b and len(a) == 64
+        assert all(t1 > t0 for t0, t1 in zip(a, a[1:]))
+        with pytest.raises(ValueError, match="rate_low"):
+            diurnal_arrivals(7, 0.0, 500.0, 1.0, 8)
+        with pytest.raises(ValueError, match="period_s"):
+            diurnal_arrivals(7, 50.0, 500.0, 0.0, 8)
+
+    def test_elastic_soak_is_byte_deterministic(self):
+        r1 = run_elastic_soak(**_FAST_SOAK)
+        r2 = run_elastic_soak(**_FAST_SOAK)
+        assert report_bytes(r1["report"]) == report_bytes(r2["report"])
+        assert r1["stats"] == r2["stats"]
+        assert r1["stats"]["scale_ups"] >= 1    # the ramp actually fired
+        assert r1["report"]["completed"] == _FAST_SOAK["n_runs"]
+        assert r1["report"]["failed"] == 0
+
+    def test_chaos_during_scale_settles_byte_identical(self):
+        def killer():
+            # crashes polled at arrival boundaries that land inside the
+            # ramp (scale events in flight) and at the peak
+            plan = FaultPlan([Fault(inject.SITE_REPLICA, 20, "crash"),
+                              Fault(inject.SITE_REPLICA, 60, "crash")])
+            return ReplicaKiller(plan)
+
+        k1 = run_elastic_soak(killer=killer(), **_FAST_SOAK)
+        k2 = run_elastic_soak(killer=killer(), **_FAST_SOAK)
+        assert report_bytes(k1["report"]) == report_bytes(k2["report"])
+        assert k1["stats"]["kills"] == 2
+        assert k1["report"]["completed"] == _FAST_SOAK["n_runs"]
+        assert k1["report"]["failed"] == 0
+        # the fleet healed: every remaining member is healthy
+        assert all(r.healthy()
+                   for r in k1["router"].replicas.values())
+        # scale stats live on the harness, never in the report
+        assert "scale_ups" not in k1["report"]
+
+    def test_soak_validates_elastic_band(self):
+        with pytest.raises(ValueError, match="elastic band"):
+            run_elastic_soak(n_min=4, n_max=4)
+
+    @pytest.mark.slow
+    def test_diurnal_ramp_acceptance_bar(self):
+        """The ISSUE acceptance bar: under the open-loop Poisson diurnal
+        ramp, the elastic fleet's p99 time-to-report is <= the static
+        n_max fleet's, with STRICTLY fewer chip-seconds."""
+        elastic = run_elastic_soak(seed=0, elastic=True)
+        static = run_elastic_soak(seed=0, elastic=False)
+        re_, rs = elastic["report"], static["report"]
+        assert re_["completed"] == rs["completed"] == 520
+        assert re_["failed"] == rs["failed"] == 0
+        assert re_["p99_ttr_s"] <= rs["p99_ttr_s"]
+        assert re_["chip_seconds"] < rs["chip_seconds"]
+        # the fleet actually breathed: grew into the ramp, drained the
+        # far side of the peak
+        assert elastic["stats"]["scale_ups"] >= 3
+        assert elastic["stats"]["scale_downs"] >= 1
+        assert static["stats"]["scale_ups"] == 0

@@ -331,6 +331,74 @@ class TestPrometheus:
         text = service.prometheus_metrics()
         assert "k8s_llm_rca_cluster_replicas_alive 2" in text
 
+    def test_autoscaler_fleet_gauges_two_way(self):
+        """Elastic-fleet exposition (cluster/autoscale.py): the
+        cluster_fleet_size{tier=} gauge and the
+        cluster_scale_events_total{kind=} counter render from the
+        router's autoscaler backref once actions fired — and stay
+        absent on a router without one (two-way coverage)."""
+        from k8s_llm_rca_tpu.cluster import (
+            Autoscaler, ClusterRouter, HealthPolicy, HealthWatchdog,
+            Replica, ReplicaSupervisor,
+        )
+        from k8s_llm_rca_tpu.serve.backend import EchoBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        tok = get_tokenizer()
+        mk = lambda i: Replica(i, EchoBackend(tok),         # noqa: E731
+                               rebuild=lambda: EchoBackend(tok))
+        router = ClusterRouter([mk(0), mk(1)])
+        # no autoscaler attached: the elastic families stay absent
+        text = prometheus_text(Metrics(), router=router)
+        assert "cluster_fleet_size" not in text
+        assert "cluster_scale_events_total" not in text
+        router.attach_health(
+            HealthWatchdog(HealthPolicy(miss_budget=1,
+                                        hung_tick_threshold=2),
+                           clock=VirtualClock()),
+            ReplicaSupervisor())
+        scaler = Autoscaler(router, reserve=[mk(2)])
+        text = prometheus_text(Metrics(), router=router)
+        assert 'k8s_llm_rca_cluster_fleet_size{tier="all"} 2' in text
+        assert "# TYPE k8s_llm_rca_cluster_fleet_size gauge" in text
+        assert "cluster_scale_events_total" not in text  # no actions yet
+        scaler.scale_up()
+        scaler.scale_down()
+        text = prometheus_text(Metrics(), router=router)
+        assert 'k8s_llm_rca_cluster_fleet_size{tier="all"} 2' in text
+        assert ('k8s_llm_rca_cluster_scale_events_total'
+                '{kind="up"} 1') in text
+        assert ('k8s_llm_rca_cluster_scale_events_total'
+                '{kind="down"} 1') in text
+        assert '{kind="rebalance"}' not in text   # never fired: no row
+        assert ("# TYPE k8s_llm_rca_cluster_scale_events_total "
+                "counter") in text
+
+    def test_autoscaler_tier_labels(self):
+        """On a TierRouter the fleet-size gauge splits per tier."""
+        from k8s_llm_rca_tpu.cluster import (
+            Autoscaler, HealthPolicy, HealthWatchdog, Replica,
+            ReplicaSupervisor, TierRouter,
+        )
+        from k8s_llm_rca_tpu.serve.backend import EchoBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        tok = get_tokenizer()
+        mk = lambda i: Replica(i, EchoBackend(tok),         # noqa: E731
+                               rebuild=lambda: EchoBackend(tok))
+        router = TierRouter([mk(0)], [mk(1), mk(2)])
+        router.attach_health(
+            HealthWatchdog(HealthPolicy(miss_budget=1,
+                                        hung_tick_threshold=2),
+                           clock=VirtualClock()),
+            ReplicaSupervisor())
+        Autoscaler(router)
+        text = prometheus_text(Metrics(), router=router)
+        assert ('k8s_llm_rca_cluster_fleet_size'
+                '{tier="prefill"} 1') in text
+        assert ('k8s_llm_rca_cluster_fleet_size'
+                '{tier="decode"} 2') in text
+
 
 # ---------------------------------------------------------------------------
 # golden byte-identity: traced seeded chaos soak (acceptance bar)
@@ -411,6 +479,44 @@ class TestTracedSoak:
         # every counter event of one sample rides that sample's track
         assert {e["tid"] for e in doc["traceEvents"]
                 if e["ph"] == "C" and e["ts"] == host[1]["ts"]} == {1}
+
+    def test_scale_events_counter_track(self):
+        """Autoscaler actions render as a running per-kind Chrome
+        counter track (cluster.scale_events) plus fleet-size samples
+        (cluster.fleet_size), mirroring the Prometheus families."""
+        from k8s_llm_rca_tpu.cluster import (
+            Autoscaler, ClusterRouter, HealthPolicy, HealthWatchdog,
+            Replica, ReplicaSupervisor,
+        )
+        from k8s_llm_rca_tpu.serve.backend import EchoBackend
+        from k8s_llm_rca_tpu.utils.tokenizer import get_tokenizer
+
+        tok = get_tokenizer()
+        mk = lambda i: Replica(i, EchoBackend(tok),         # noqa: E731
+                               rebuild=lambda: EchoBackend(tok))
+        clock = VirtualClock()
+        tr = Tracer(clock=clock)
+        with obs_trace.tracing(tr):
+            router = ClusterRouter([mk(0), mk(1)])
+            router.attach_health(
+                HealthWatchdog(HealthPolicy(miss_budget=1,
+                                            hung_tick_threshold=2),
+                               clock=clock),
+                ReplicaSupervisor())
+            scaler = Autoscaler(router, reserve=[mk(2)], clock=clock)
+            scaler.scale_up()
+            clock.sleep(0.001)
+            scaler.scale_down()
+        doc = chrome_trace(tr)
+        validate_chrome_trace(doc)
+        tracks = [e for e in doc["traceEvents"]
+                  if e["ph"] == "C" and e["name"] == "cluster.scale_events"]
+        # running counts per kind, one sample per action
+        assert [t["args"] for t in tracks] == [{"up": 1},
+                                               {"down": 1, "up": 1}]
+        fleet = [e for e in doc["traceEvents"]
+                 if e["ph"] == "C" and e["name"] == "cluster.fleet_size"]
+        assert [f["args"]["alive"] for f in fleet] == [3, 2]
 
 
 # ---------------------------------------------------------------------------
@@ -663,6 +769,33 @@ class TestSiteCoverage:
             assert disagg_out[h_d].error is None
             assert disagg_router.handoffs == 1
         assert "cluster.handoff" in tr_disagg.emitted_names()
+
+        # (12) elastic-fleet sites: a scale-up spawn through the
+        # supervisor rebuild-recipe path and a drain-down retirement
+        # both emit the cluster.scale event (cluster/autoscale.py)
+        from k8s_llm_rca_tpu.cluster import Autoscaler
+
+        tr_scale = Tracer(clock=VirtualClock())
+        tracers.append(tr_scale)
+        with obs_trace.tracing(tr_scale):
+            scale_router = ClusterRouter(
+                [Replica(0, EchoBackend(tok),
+                         rebuild=lambda: EchoBackend(tok)),
+                 Replica(1, EchoBackend(tok),
+                         rebuild=lambda: EchoBackend(tok))])
+            scale_router.attach_health(
+                HealthWatchdog(HealthPolicy(miss_budget=1,
+                                            hung_tick_threshold=2),
+                               clock=VirtualClock()),
+                ReplicaSupervisor())
+            scaler = Autoscaler(
+                scale_router,
+                reserve=[Replica(2, EchoBackend(tok),
+                                 rebuild=lambda: EchoBackend(tok))])
+            up = scaler.scale_up()
+            down = scaler.scale_down()
+            assert up["kind"] == "up" and down["kind"] == "down"
+        assert "cluster.scale" in tr_scale.emitted_names()
 
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
