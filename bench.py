@@ -543,18 +543,36 @@ def bench_rca_chaos(seed: int = 0, n_incidents: int = 6):
             "seed": seed, "n": n_incidents}
 
 
-def bench_obs(seed: int = 0, n_incidents: int = 2):
+def bench_obs(seed: int = 0, n_incidents: int = 2, n_pings: int = 40):
     """Flight-recorder leg: the seeded chaos soak (engine backend) traced
     end-to-end by obs/ — span counts, engine tick samples, and the
     Chrome-trace/Prometheus export sizes are EXACT measurements of the
     run (measurement-or-null applies trivially, like the chaos leg).
     Runs in its own interpreter, so tracing cannot perturb any other
     leg's timings; the trace itself is validated (sorted ts, complete X
-    events) before anything is published."""
+    events) before anything is published.
+
+    Fleet half (obs/trace.py telemetry seam + cluster/proc.py shipping),
+    same trust argument as ``bench_proc_cluster`` — echo workers on CPU,
+    so every wall-clock here is LOCAL pipe/process cost the tunnel's
+    memoizer cannot touch:
+
+    - ``telemetry_overhead_pct``: relative cost of span shipping on the
+      RPC round-trip, measured as ``n_pings`` distinct-payload pings on a
+      traced+shipping worker vs the same pings on an identical worker
+      with telemetry off.
+    - ``telemetry_frames``: exact count of reply frames that carried a
+      telemetry payload during the traced run (count-exact).
+    - ``fleet_trace_bytes``: serialized size of the MERGED multi-process
+      Chrome trace (parent + worker incarnation track), validated
+      (per-pid metadata, flow pairing) before anything is published.
+    - ``critical_path_ms``: wall-clock of one ``critical_path`` merge /
+      decomposition pass over that fleet tree (host-side pure Python)."""
+    from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
     from k8s_llm_rca_tpu.faults.soak import run_chaos_soak
     from k8s_llm_rca_tpu.obs import (
-        Tracer, chrome_trace, chrome_trace_bytes, prometheus_text,
-        validate_chrome_trace,
+        Tracer, chrome_trace, chrome_trace_bytes, critical_path,
+        prometheus_text, tracing, validate_chrome_trace,
     )
     from k8s_llm_rca_tpu.utils.logging import METRICS
 
@@ -564,6 +582,48 @@ def bench_obs(seed: int = 0, n_incidents: int = 2):
     doc = chrome_trace(tracer)
     n_events = validate_chrome_trace(doc)
     prom = prometheus_text(METRICS)
+
+    # --- fleet telemetry: shipping-on vs shipping-off ping walls
+    def _ping_wall(replica, n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            replica.backend._rpc("ping", probe=i)
+        return time.perf_counter() - t0
+
+    fleet_trace_bytes = None
+    telemetry_frames = None
+    overhead_pct = None
+    critical_path_ms = None
+    fleet_tr = Tracer()
+    (traced_rep,) = build_proc_replicas(1, kind="echo", trace=True)
+    try:
+        with tracing(fleet_tr):
+            on_wall = _ping_wall(traced_rep, n_pings)
+            traced_rep.backend.drain_telemetry()
+            telemetry_frames = traced_rep.backend.telemetry_frames
+        # the merged doc needs a run root for critical_path to attribute
+        # the pings' wire time against (serve.run is how runs are found)
+        fleet_tr.add_span("serve.run", 0.0, fleet_tr.now(), cat="serve",
+                          args={"run": "bench-fleet",
+                                "status": "completed"})
+        fleet_doc = chrome_trace(fleet_tr)
+        validate_chrome_trace(fleet_doc)
+        fleet_trace_bytes = len(chrome_trace_bytes(fleet_doc))
+        t0 = time.perf_counter()
+        cp = critical_path(fleet_tr)
+        critical_path_ms = round((time.perf_counter() - t0) * 1000.0, 4)
+        if not cp:
+            critical_path_ms = None
+    finally:
+        traced_rep.close()
+    (plain_rep,) = build_proc_replicas(1, kind="echo")
+    try:
+        off_wall = _ping_wall(plain_rep, n_pings)
+    finally:
+        plain_rep.close()
+    if off_wall > 0:
+        overhead_pct = round((on_wall - off_wall) / off_wall * 100.0, 2)
+
     return {"spans": len(tracer.spans),
             "events": len(tracer.events),
             "ticks": int(tracer.timeline.total),
@@ -571,6 +631,10 @@ def bench_obs(seed: int = 0, n_incidents: int = 2):
             "trace_bytes": len(chrome_trace_bytes(doc)),
             "prom_lines": prom.count("\n"),
             "dropped": tracer.dropped,
+            "fleet_trace_bytes": fleet_trace_bytes,
+            "telemetry_frames": telemetry_frames,
+            "telemetry_overhead_pct": overhead_pct,
+            "critical_path_ms": critical_path_ms,
             "seed": seed, "n": n_incidents}
 
 
@@ -1727,6 +1791,15 @@ def main():
         "obs_engine_ticks": obs.get("ticks"),
         "obs_trace_bytes": obs.get("trace_bytes"),
         "obs_prom_lines": obs.get("prom_lines"),
+        # fleet flight recorder (obs/ + cluster/proc.py telemetry
+        # shipping): merged-trace size and shipped-frame count are
+        # count-exact; the shipping overhead and critical-path merge
+        # cost are local pipe/host wall-clock (echo workers never touch
+        # the tunnel); null when the leg failed — schema stays stable
+        "obs_fleet_trace_bytes": obs.get("fleet_trace_bytes"),
+        "obs_telemetry_frames": obs.get("telemetry_frames"),
+        "obs_telemetry_overhead_pct": obs.get("telemetry_overhead_pct"),
+        "obs_critical_path_ms": obs.get("critical_path_ms"),
         # durability (serve/journal.py + serve/recover.py): fsync'd
         # append cost, recovery replay wall-clock, and the re-prefill
         # prefix-HIT ratio after a crash, each measured in its own

@@ -383,19 +383,24 @@ class TierRouter(ClusterRouter):
                         "belong on a HandoffKiller plan)", fault.kind)
             fault = None
         # ---- EXPORT: freeze on the prefill side, source stays pinned
-        if self._kv_seam:
-            try:
-                frame = src.backend.export_run(src_lh)
-            except wire_errors as e:
-                self._retry(ghandle, "export", f"{type(e).__name__}: {e}")
-                return
-            if frame is None:
-                return         # not exportable THIS pump — not a retry
-        else:
-            # scripted tiers carry no KV: a synthetic frame keeps the
-            # 2PC (and its fault/kill surface) identical
-            frame = {"seq": {"scripted": True, "run": ghandle},
-                     "kv": None}
+        # (phase spans feed the critical-path pass, obs/critical_path.py:
+        # zero duration under a VirtualClock, real wire time otherwise)
+        with obs_trace.span("cluster.handoff.export", cat="handoff",
+                            run=ghandle, src=src_rid, dst=dst_rid):
+            if self._kv_seam:
+                try:
+                    frame = src.backend.export_run(src_lh)
+                except wire_errors as e:
+                    self._retry(ghandle, "export",
+                                f"{type(e).__name__}: {e}")
+                    return
+                if frame is None:
+                    return     # not exportable THIS pump — not a retry
+            else:
+                # scripted tiers carry no KV: a synthetic frame keeps
+                # the 2PC (and its fault/kill surface) identical
+                frame = {"seq": {"scripted": True, "run": ghandle},
+                         "kv": None}
         if fault is not None and fault.kind == "drop":
             self._retry(ghandle, "export", "injected frame drop")
             return
@@ -419,32 +424,36 @@ class TierRouter(ClusterRouter):
                             "decode side died before ADOPT")
                 return
         # ---- ADOPT: all-or-nothing on the decode side
-        if self._kv_seam:
-            try:
-                new_lh = dst.backend.adopt_run(frame, opts)
-            except wire_errors as e:
-                # the ack never arrived; the adopter MAY hold a twin,
-                # but the incarnation(+nonce) fence discards any late
-                # reply and an orphan twin's result is dropped by the
-                # parent mirror (proc.py pump) — retry from the source
-                self._retry(ghandle, "adopt",
-                            f"ack lost ({type(e).__name__}): {e}")
-                return
-            except ValueError as e:
-                # torn frame: discarded whole before any engine state
-                # moved on the adopter
-                self._retry(ghandle, "adopt", f"torn frame: {e}")
-                return
-        else:
-            try:
-                self._scripted_frame_check(frame)
-            except ValueError as e:
-                self._retry(ghandle, "adopt", f"torn frame: {e}")
-                return
-            # deterministic re-start stands in for ADOPT: a re-admission
-            # of an already-admitted run (no armed-plan polls)
-            with inject.readmission():
-                new_lh = dst.backend.start(prompt, opts)
+        with obs_trace.span("cluster.handoff.adopt", cat="handoff",
+                            run=ghandle, src=src_rid, dst=dst_rid):
+            if self._kv_seam:
+                try:
+                    new_lh = dst.backend.adopt_run(frame, opts)
+                except wire_errors as e:
+                    # the ack never arrived; the adopter MAY hold a
+                    # twin, but the incarnation(+nonce) fence discards
+                    # any late reply and an orphan twin's result is
+                    # dropped by the parent mirror (proc.py pump) —
+                    # retry from the source
+                    self._retry(ghandle, "adopt",
+                                f"ack lost ({type(e).__name__}): {e}")
+                    return
+                except ValueError as e:
+                    # torn frame: discarded whole before any engine
+                    # state moved on the adopter
+                    self._retry(ghandle, "adopt", f"torn frame: {e}")
+                    return
+            else:
+                try:
+                    self._scripted_frame_check(frame)
+                except ValueError as e:
+                    self._retry(ghandle, "adopt", f"torn frame: {e}")
+                    return
+                # deterministic re-start stands in for ADOPT: a
+                # re-admission of an already-admitted run (no
+                # armed-plan polls)
+                with inject.readmission():
+                    new_lh = dst.backend.start(prompt, opts)
         if fault is not None and fault.kind == "stale-fence":
             # the ack lost the fencing race (a newer incarnation/nonce
             # took over mid-transfer): the adopted twin must die, the
@@ -457,19 +466,21 @@ class TierRouter(ClusterRouter):
                         "discarded; adopted twin cancelled")
             return
         # ---- RELEASE: the adopter acked — free the pinned source copy
-        self._local.pop((src_rid, src_lh), None)
-        try:
-            src.backend.cancel(src_lh)
-        except (WireError, OSError):
-            pass               # dying source: its state is gone anyway
-        self._handle_map[ghandle] = (dst_rid, new_lh)
-        self._local[(dst_rid, new_lh)] = ghandle
-        retries = self._handoff_queue.pop(ghandle, 0)
-        self.handoffs += 1
-        METRICS.inc("cluster.handoffs")
-        obs_trace.event("cluster.handoff", run=ghandle, src=src_rid,
-                        dst=dst_rid, retries=retries,
-                        kv=bool(frame.get("kv")))
+        with obs_trace.span("cluster.handoff.release", cat="handoff",
+                            run=ghandle, src=src_rid, dst=dst_rid):
+            self._local.pop((src_rid, src_lh), None)
+            try:
+                src.backend.cancel(src_lh)
+            except (WireError, OSError):
+                pass           # dying source: its state is gone anyway
+            self._handle_map[ghandle] = (dst_rid, new_lh)
+            self._local[(dst_rid, new_lh)] = ghandle
+            retries = self._handoff_queue.pop(ghandle, 0)
+            self.handoffs += 1
+            METRICS.inc("cluster.handoffs")
+            obs_trace.event("cluster.handoff", run=ghandle, src=src_rid,
+                            dst=dst_rid, retries=retries,
+                            kv=bool(frame.get("kv")))
 
     def _retry(self, ghandle: int, stage: str, why: str) -> None:
         """Record one discarded transfer attempt; the run stays whole

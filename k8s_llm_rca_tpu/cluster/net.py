@@ -30,6 +30,14 @@ reply with the adopted nonce so the parent can discard frames from a
 link it already abandoned.  ``client_handshake`` implements the parent
 half; the worker half lives in ``cluster/proc.py``'s ``--listen`` serve
 loop.
+
+Fleet telemetry (cluster/proc.py) is transport-transparent by design:
+the ``trace`` propagation context and piggybacked ``tel`` payloads are
+ordinary JSON fields inside ordinary frames, so both transports carry
+them unchanged — and the nonce fencing above is what lets the parent
+trust that a telemetry payload came from the incarnation it is
+attributed to (a stale link's frames, telemetry included, are
+discarded before ingestion).
 """
 
 from __future__ import annotations

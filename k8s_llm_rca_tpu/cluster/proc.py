@@ -36,10 +36,13 @@ module makes the fault domain real:
 
 Protocol (one JSON frame per message, cluster/wire.py framing):
 
-  parent -> worker: ``{"op", "id", ...}``; worker -> parent: ``{"id",
-  "inc", "hb", ...}`` (or ``{"err": {"type", "msg"}}``).  Ops: ready
-  (handshake, worker-initiated), ping, start, pump, cancel, snapshot,
-  adopt, drain.  GenOptions cross the wire as serve/journal.py's
+  parent -> worker: ``{"op", "id", ...}`` (plus an optional ``trace``
+  propagation context when the spec opts into telemetry); worker ->
+  parent: ``{"id", "inc", "hb", ...}`` (or ``{"err": {"type",
+  "msg"}}``), optionally carrying a piggybacked ``tel`` telemetry
+  payload.  Ops: ready (handshake, worker-initiated), ping, start,
+  pump, cancel, snapshot, adopt, export_run, adopt_run,
+  drain_telemetry, drain.  GenOptions cross the wire as serve/journal.py's
   ``encode_gen`` dicts (grammar as SPEC — compiled FSMs never cross a
   process boundary); engine state crosses as the JSON-safe
   ``snapshot_sequences`` export.
@@ -212,17 +215,122 @@ def _result_to_json(res) -> Dict[str, Any]:
             "expired": bool(res.expired)}
 
 
-def _handle_op(msg: Dict[str, Any], backend, state: Dict[str, int],
+# telemetry shipping (spec {"trace": true}): the worker buffers completed
+# spans / events / TickSamples in a bounded ring and piggybacks up to
+# REPLY_BUDGET items on every reply frame; drain ops flush DRAIN_BUDGET
+# per turn.  Both budgets keep a reply frame far under
+# wire.MAX_FRAME_SIZE; a SIGKILL loses at most the ring (bounded loss).
+DEFAULT_TELEMETRY_RING = 4096
+TELEMETRY_REPLY_BUDGET = 64
+TELEMETRY_DRAIN_BUDGET = 1024
+
+
+class _WorkerTelemetry:
+    """Worker half of telemetry shipping: watches the worker's own
+    Tracer for newly-COMPLETED spans (the worker is single-threaded, so
+    the span store is a completed prefix between ops), new events, and
+    new TickSamples, converts them to wire form, and buffers them in a
+    TelemetryRing until a reply frame carries them out."""
+
+    def __init__(self, tracer, ring_capacity: int = DEFAULT_TELEMETRY_RING):
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        self.tracer = tracer
+        self.ring = obs_trace.TelemetryRing(ring_capacity)
+        self._wire = (obs_trace.span_to_wire, obs_trace.event_to_wire,
+                      obs_trace.tick_to_wire)
+        self._spans_seen = 0
+        self._events_seen = 0
+        self._ticks_seen = 0
+
+    def collect(self) -> None:
+        span_fn, event_fn, tick_fn = self._wire
+        spans = self.tracer.spans
+        i = self._spans_seen
+        while i < len(spans) and spans[i].t1 is not None:
+            self.ring.push(span_fn(spans[i]))
+            i += 1
+        self._spans_seen = i
+        for ev in self.tracer.events[self._events_seen:]:
+            self.ring.push(event_fn(ev))
+        self._events_seen = len(self.tracer.events)
+        delta = self.tracer.timeline.total - self._ticks_seen
+        if delta > 0:
+            samples = self.tracer.timeline.samples()
+            fresh = samples[max(0, len(samples) - delta):]
+            # ticks the timeline ring overwrote before we got here are
+            # loss too — count them with the ring's own shed
+            self.ring.shed += delta - len(fresh)
+            for s in fresh:
+                self.ring.push(tick_fn(s))
+            self._ticks_seen = self.tracer.timeline.total
+
+    def payload(self, budget: int,
+                counters: bool = False) -> Optional[Dict[str, Any]]:
+        items = self.ring.pop(budget)
+        if not items and not counters:
+            return None
+        p: Dict[str, Any] = {
+            "pid": os.getpid(), "items": items,
+            "shed": self.ring.shed + self.tracer.dropped,
+            "more": len(self.ring) > 0}
+        if counters:
+            p["counters"] = METRICS.snapshot()
+        return p
+
+
+def _build_worker_telemetry(spec: Dict[str, Any]):
+    """Worker tracer + shipping ring when the spec opts in
+    (``{"trace": true}``) — the worker tracer runs on a PropagatedClock
+    so its spans are stamped in the parent's (possibly virtual)
+    timebase, and it is module-activated so the engine's existing
+    instrumentation records into it untouched."""
+    if not spec.get("trace"):
+        return None
+    from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+    tracer = obs_trace.Tracer(clock=obs_trace.PropagatedClock())
+    obs_trace.activate(tracer)
+    return _WorkerTelemetry(
+        tracer,
+        ring_capacity=int(spec.get("telemetry_ring",
+                                   DEFAULT_TELEMETRY_RING)))
+
+
+def _handle_op(msg: Dict[str, Any], backend, state: Dict[str, Any],
                inc: int, hb) -> Tuple[Dict[str, Any], bool]:
     """One decoded request -> ``(reply, drain)`` — shared by the pipe
     loop and both socket serve loops so every transport speaks the exact
     same op surface.  The reply is hb-stamped; the serve loop that owns
-    the link stamps the session nonce (socket modes only)."""
+    the link stamps the session nonce (socket modes only).
+
+    When the worker runs a tracer (``state["tel"]``, spec
+    ``{"trace": true}``), each handled op is bracketed by a
+    ``cluster.proc.serve`` span parented onto the request's propagated
+    trace context, and the reply frame piggybacks a bounded telemetry
+    payload — shipping rides frames that exist anyway, so it can never
+    change a fault draw."""
     from k8s_llm_rca_tpu.serve.journal import decode_gen
 
     op = msg.get("op")
     reply: Dict[str, Any] = {"id": msg.get("id"), "inc": inc}
     drain = False
+    tel = state.get("tel")
+    serve_span = None
+    if tel is not None:
+        ctx = msg.get("trace") or {}
+        if "ts" in ctx:
+            tel.tracer.clock.advance_to(ctx["ts"])
+        serve_span = tel.tracer.begin(
+            "cluster.proc.serve", cat="cluster",
+            args={"op": op, "trace": ctx.get("id"),
+                  "link": ctx.get("parent")})
+        if serve_span is not None and ctx.get("parent") is not None:
+            # parent onto the PROPAGATED context: the serve span is a
+            # worker-side root, so its parent is the parent process's
+            # cluster.proc.rpc span (args.link keeps the id visible in
+            # the merged trace UI, where X events hide parentage)
+            serve_span.parent_id = int(ctx["parent"])
     try:
         if op == "ping":
             reply["ok"] = True
@@ -265,6 +373,11 @@ def _handle_op(msg: Dict[str, Any], backend, state: Dict[str, int],
             # parent-side) BEFORE any engine state moved
             reply["handle"] = backend.adopt_run(msg["frame"],
                                                 decode_gen(msg["gen"]))
+        elif op == "drain_telemetry":
+            # explicit flush (parent close() / watchdog relink heal):
+            # touches ONLY the telemetry ring — no backend call, no
+            # fault-site poll, so shipping can never change a fault draw
+            reply["ok"] = True
         elif op == "drain":
             # graceful shutdown: finish nothing, ack, exit 0 — the
             # parent has already migrated/cancelled what it wanted
@@ -275,6 +388,17 @@ def _handle_op(msg: Dict[str, Any], backend, state: Dict[str, int],
     except Exception as e:                    # noqa: BLE001 — crosses wire
         reply = {"id": msg.get("id"), "inc": inc,
                  "err": {"type": type(e).__name__, "msg": str(e)}}
+    if tel is not None:
+        # close the serve span BEFORE collecting, so op N's own span is
+        # part of the completed prefix and ships in reply N
+        tel.tracer.end(serve_span)
+        tel.collect()
+        big = op in ("drain", "drain_telemetry")
+        payload = tel.payload(
+            TELEMETRY_DRAIN_BUDGET if big else TELEMETRY_REPLY_BUDGET,
+            counters=big)
+        if payload is not None:
+            reply["tel"] = payload
     reply["hb"] = hb()
     return reply, drain
 
@@ -328,7 +452,7 @@ def _refuse(transport, inc: int, err_type: str, msg: str) -> None:
     transport.close()
 
 
-def _serve_frames(conn, backend, state: Dict[str, int], inc: int, hb,
+def _serve_frames(conn, backend, state: Dict[str, Any], inc: int, hb,
                   corrupt_after, hang_after) -> str:
     """Answer every frame currently available on a readable link (one
     select wakeup can deliver many frames — drain via ``pending()``).
@@ -365,7 +489,7 @@ _LEASH_CHUNK = 4096
 
 
 def _serve_listen(spec: Dict[str, Any], out, backend,
-                  state: Dict[str, int], inc: int, hb) -> int:
+                  state: Dict[str, Any], inc: int, hb) -> int:
     """``--listen`` socket mode: bind loopback (or ``listen_host``),
     announce the port in a ``listening`` bootstrap frame on stdout (the
     ONLY frame stdout ever carries in socket mode), then serve the op
@@ -440,7 +564,7 @@ def _serve_listen(spec: Dict[str, Any], out, backend,
 
 
 def _serve_connect(spec: Dict[str, Any], peer: Tuple[str, int], backend,
-                   state: Dict[str, int], inc: int, hb) -> int:
+                   state: Dict[str, Any], inc: int, hb) -> int:
     """``--connect`` socket mode: the cross-host inversion where the
     WORKER dials a listening parent (NAT/firewall-friendly) and serves
     the identical fenced protocol — the parent still initiates the
@@ -535,7 +659,8 @@ def worker_main(argv: Sequence[str]) -> int:
     hang_after = spec.get("chaos_hang_after")
 
     backend, hb_fn = _build_worker_backend(spec)
-    state = {"pumps": 0, "handled": 0}
+    state: Dict[str, Any] = {"pumps": 0, "handled": 0,
+                             "tel": _build_worker_telemetry(spec)}
 
     def hb() -> int:
         return hb_fn() if hb_fn is not None else state["pumps"]
@@ -633,6 +758,14 @@ class ProcBackend:
         self.last_heartbeat: Optional[int] = None
         self.rpcs = 0
         self.spawn_s: Optional[float] = None
+        # fleet flight recorder (spec {"trace": true}): outbound frames
+        # carry the active tracer's propagation context; reply frames
+        # carry back worker telemetry, ingested into the tracer's
+        # remote store keyed (replica_id, incarnation)
+        self.telemetry = bool(self.spec.get("trace"))
+        self.telemetry_frames = 0
+        self.telemetry_items = 0
+        self._tel_more = False
         if self.kind == "engine":
             # count_tokens stays parent-side (one RPC per usage line
             # would dominate the protocol); the tokenizer is the
@@ -928,7 +1061,13 @@ class ProcBackend:
         effective = (timeout_s if timeout_s is not None
                      else self.rpc_timeout_s)
         with obs_trace.span("cluster.proc.rpc", cat="cluster", op=op,
-                            replica=self.replica_id):
+                            replica=self.replica_id) as rpc_span:
+            tr = obs_trace.active()
+            if self.telemetry and tr is not None:
+                # span-context propagation: the worker's serve span
+                # parents onto THIS rpc span, so one run's tree spans
+                # router -> wire -> worker engine ticks
+                req["trace"] = tr.context(parent=rpc_span)
             try:
                 self._transport.send(req, timeout_s=effective)
                 resp = self._recv_reply(req, effective)
@@ -957,6 +1096,11 @@ class ProcBackend:
             raise WireEOF(self._dead_evidence)
         if resp.get("hb") is not None:
             self.last_heartbeat = int(resp["hb"])
+        tel = resp.get("tel")
+        if tel is not None:
+            # past both fences: this payload provably belongs to this
+            # incarnation's worker
+            self._ingest_telemetry(tel)
         err = resp.get("err")
         if err is not None:
             if err.get("type") == "BudgetError":
@@ -965,6 +1109,48 @@ class ProcBackend:
                 f"proc replica {self.replica_id} worker {op} failed: "
                 f"{err.get('type')}: {err.get('msg')}")
         return resp
+
+    def _ingest_telemetry(self, payload: Dict[str, Any]) -> None:
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        self._tel_more = bool(payload.get("more"))
+        tr = obs_trace.active()
+        if tr is None:
+            return
+        n = tr.ingest_remote(self.replica_id, self.incarnation, payload)
+        self.telemetry_frames += 1
+        self.telemetry_items += n
+        if n:
+            obs_trace.event("cluster.telemetry.ship",
+                            replica=self.replica_id,
+                            incarnation=self.incarnation, items=n)
+
+    def drain_telemetry(self, max_frames: int = 64) -> int:
+        """Flush the worker's remaining buffered telemetry with
+        dedicated ``drain_telemetry`` ops (each polls NO fault sites).
+        Called by ``close()`` and by the router's relink-heal path; a
+        transport failure mid-drain is swallowed — the at-most-bounded-
+        loss contract already covers whatever stayed in the ring.
+        Returns the number of items recovered this flush."""
+        from k8s_llm_rca_tpu.obs import trace as obs_trace
+
+        if not self.telemetry:
+            return 0
+        before = self.telemetry_items
+        if (self.proc_liveness() is None
+                and self.link_liveness() is None):
+            for _ in range(max_frames):
+                try:
+                    self._rpc("drain_telemetry")
+                except (WireError, OSError, WorkerError):
+                    break
+                if not self._tel_more:
+                    break
+        n = self.telemetry_items - before
+        obs_trace.event("cluster.telemetry.drain",
+                        replica=self.replica_id,
+                        incarnation=self.incarnation, items=n)
+        return n
 
     # -------------------------------------------------------------- backend
 
@@ -1140,6 +1326,10 @@ class ProcBackend:
                 except OSError:
                     pass
             else:
+                if self.telemetry:
+                    # last flush before the worker exits — the drain
+                    # reply below carries one more big payload too
+                    self.drain_telemetry()
                 try:
                     self._rpc("drain", timeout_s=timeout_s)
                 except (WireError, OSError, WorkerError):

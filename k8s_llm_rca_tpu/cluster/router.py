@@ -430,6 +430,13 @@ class ClusterRouter:
                 # verdict; budget exhaustion converts the outage into
                 # hard "link" evidence, which escalates like any death.
                 if replica.relink():
+                    # watchdog-heal flush (cluster/proc.py telemetry
+                    # shipping): telemetry buffered while the link was
+                    # down ships before the replay re-drives the runs
+                    drain_tel = getattr(replica.backend,
+                                        "drain_telemetry", None)
+                    if drain_tel is not None:
+                        drain_tel()
                     self._replay_relinked(rid)
                 else:
                     if (self.health is not None

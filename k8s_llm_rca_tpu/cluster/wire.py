@@ -7,7 +7,10 @@ corruption semantics are shared with the journal: a torn or bit-flipped
 frame is detected by length/CRC validation, never parsed.  The payload is
 canonical JSON (sorted keys) — the protocol carries only JSON-safe state
 by design (GenOptions ride serve/journal.py's ``encode_gen``; engine
-snapshots are the JSON-safe ``snapshot_sequences`` export).
+snapshots are the JSON-safe ``snapshot_sequences`` export; the fleet
+flight recorder's optional ``trace`` propagation context on requests and
+``tel`` telemetry payload on replies are plain JSON fields that ride the
+same framing untouched — the codec neither knows nor cares).
 
 The crucial difference from the WAL is the FAILURE CONTRACT.  The WAL
 reader stops at the first bad frame and keeps the clean prefix (a torn

@@ -112,7 +112,8 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
                            selfheal: bool = False, health_policy=None,
                            proc: bool = False, transport: str = "pipe",
                            tier_split: Optional[Tuple[int, int]] = None,
-                           handoff_plan=None):
+                           handoff_plan=None,
+                           fleet_telemetry: bool = False):
     """N-replica serving behind a ClusterRouter (cluster/).  ``oracle``
     replicas are scripted backends — the cheap mode the 100-incident
     replica-kill soak runs on (tier-1 budget); engine replicas reuse the
@@ -143,6 +144,15 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
     through the transactional EXPORT -> ADOPT -> RELEASE handoff.
     ``handoff_plan``: the TierRouter's own SITE_HANDOFF FaultPlan.
 
+    ``fleet_telemetry``: opt proc workers into the fleet flight
+    recorder (cluster/proc.py telemetry shipping) — each worker runs
+    its own Tracer and ships spans/ticks back on reply frames.  OFF by
+    default and deliberately NOT inferred from an active tracer, so a
+    soak's spec (and therefore its worker argv) only changes when the
+    caller asks; shipping polls no fault sites either way, which is the
+    telemetry-on-vs-off report byte-identity bar
+    (tests/test_fleet_obs.py).
+
     Returns ``(service, engines, factory, router)`` — ``engines`` is the
     per-replica engine list ([] for oracle replicas) so the caller can
     assert EVERY replica ends clean, and ``factory`` returns the SAME
@@ -154,8 +164,11 @@ def _build_cluster_service(run_timeout_s: float, clock, journal=None,
     if proc:
         from k8s_llm_rca_tpu.cluster.proc import build_proc_replicas
 
-        replicas = build_proc_replicas(n_replicas, kind="oracle",
-                                       transport=transport)
+        # telemetry-off keeps the spec (and worker argv) byte-identical
+        # to the pre-flight-recorder fleet: the flag only exists when on
+        replicas = build_proc_replicas(
+            n_replicas, kind="oracle", transport=transport,
+            **({"trace": True} if fleet_telemetry else {}))
         engines = []
     elif oracle:
         from k8s_llm_rca_tpu.rca.oracle import OracleBackend
@@ -258,7 +271,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    selfheal: bool = False,
                    concurrency: int = 1,
                    tier_split: Optional[Tuple[int, int]] = None,
-                   handoff_plan: Optional[FaultPlan] = None
+                   handoff_plan: Optional[FaultPlan] = None,
+                   fleet_telemetry: bool = False
                    ) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
@@ -299,6 +313,14 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     timestamp is virtual and the exported Chrome trace is byte-identical
     run over run (the flight recorder's golden acceptance bar).  The
     report then carries a deterministic ``flight`` summary.
+
+    ``fleet_telemetry`` (proc backends only): opt the out-of-process
+    workers into the fleet flight recorder — each worker runs its own
+    Tracer and ships spans/ticks back piggybacked on reply frames, so a
+    traced soak's merged Chrome trace gains one pid track per worker
+    incarnation.  Shipping polls NO fault sites and adds NO report
+    fields: ``faults.polls`` and ``report_bytes`` stay byte-identical
+    with telemetry on or off (tests/test_fleet_obs.py proves the bar).
 
     ``durable_dir``: optional directory for the write-ahead run journal
     (serve/journal.py) — every service mutation becomes a durable record.
@@ -394,6 +416,13 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
             f"handoff_plan only applies to backend='disagg-cluster' "
             f"(got backend={backend!r}): SITE_HANDOFF is only polled "
             f"inside a TierRouter's transfer attempts")
+    if fleet_telemetry and backend not in ("proc-cluster", "net-cluster",
+                                           "disagg-cluster"):
+        raise ValueError(
+            f"fleet_telemetry only applies to out-of-process backends "
+            f"('proc-cluster'/'net-cluster'/'disagg-cluster', got "
+            f"backend={backend!r}): in-process replicas already share "
+            f"the parent tracer — there is nothing to ship")
     if backend == "disagg-cluster" and tier_split is None:
         # prefill-heavy default: the RCA corpus is long-prompt/short-
         # verdict, so ceil(n/2) exporters feed floor(n/2) adopters
@@ -446,7 +475,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                                                "disagg-cluster")
                        else "pipe"),
             selfheal=selfheal,
-            tier_split=tier_split, handoff_plan=handoff_plan)
+            tier_split=tier_split, handoff_plan=handoff_plan,
+            fleet_telemetry=fleet_telemetry)
         engine = None   # "engine_clean" is per-replica below
     elif selfheal:
         raise ValueError("selfheal requires a cluster backend: the "
@@ -877,6 +907,11 @@ def run_pipelined_sweep(seed: int = 0, n_incidents: int = 10,
         stats["policy"] = snap
     if tracer is not None:
         stats["flight"] = tracer.flight_summary()
+        # per-run latency decomposition (obs/critical_path.py): like the
+        # flight digest it reads the tracer, so it is stats territory —
+        # scheduling changes queue-wait shares, never report bytes
+        from k8s_llm_rca_tpu.obs import critical_path_stats
+        stats["critical_path"] = critical_path_stats(tracer)
     return {"report": report, "stats": stats, "service": service,
             "engines": engines, "router": router}
 

@@ -1,24 +1,33 @@
 """End-to-end flight recorder: span tracing, engine tick timeline, and
 exporters (Chrome trace-event JSON + Prometheus text) across the
-RCA/serve/engine stack.
+RCA/serve/engine stack — in-process AND across the out-of-process fleet.
 
 - ``obs.trace`` — deterministic span tracer (injectable clock, bounded
   store, module activation slot mirroring faults/inject.py) + the SITES
-  registry and its coverage self-check;
+  registry and its coverage self-check, plus the fleet telemetry seam:
+  span-context propagation (``Tracer.context``), worker-side
+  ``PropagatedClock``/``TelemetryRing``, and parent-side
+  ``Tracer.ingest_remote``;
 - ``obs.timeline`` — per-engine-tick gauge samples in a bounded ring;
 - ``obs.export`` — Chrome trace (Perfetto-loadable, byte-stable under a
-  VirtualClock) and Prometheus text exposition renderers.
+  VirtualClock; one pid track per worker incarnation, handoff flow
+  events) and Prometheus text exposition renderers;
+- ``obs.critical_path`` — per-run end-to-end latency decomposition over
+  the merged tree (integer-µs segments summing exactly to the total).
 
 See docs/observability.md for the capture/read workflow and the metric
 name registry.
 """
 
+from k8s_llm_rca_tpu.obs.critical_path import (  # noqa: F401
+    SEGMENTS, critical_path, critical_path_stats,
+)
 from k8s_llm_rca_tpu.obs.export import (   # noqa: F401
     chrome_trace, chrome_trace_bytes, prometheus_text,
     validate_chrome_trace,
 )
 from k8s_llm_rca_tpu.obs.timeline import TickSample, TickTimeline  # noqa: F401
 from k8s_llm_rca_tpu.obs.trace import (    # noqa: F401
-    SITES, Span, SpanEvent, Tracer, active, coverage_missing, event, span,
-    tracing,
+    SITES, PropagatedClock, Span, SpanEvent, TelemetryRing, Tracer,
+    active, coverage_missing, event, span, tracing,
 )

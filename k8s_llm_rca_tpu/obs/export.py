@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Set
 
+from k8s_llm_rca_tpu.obs.timeline import TickSample
 from k8s_llm_rca_tpu.obs.trace import Tracer
 
 _PREFIX = "k8s_llm_rca_"
@@ -33,6 +34,52 @@ _PREFIX = "k8s_llm_rca_"
 
 def _us(t: float) -> int:
     return int(round(t * 1e6))
+
+
+def _tick_counter_events(s, pid: int) -> List[Dict[str, Any]]:
+    """The "C" counter-track events for one TickSample on one Chrome
+    pid — shared by the parent's timeline and the per-worker fleet
+    tracks so both render the identical family set."""
+    # tid = replica id (0 outside a cluster): per-replica counter
+    # tracks separate in Perfetto instead of interleaving
+    base = {"ph": "C", "ts": _us(s.ts), "pid": pid, "tid": s.engine_id}
+    events = [{**base, "name": "engine.seqs",
+               "args": {"running": s.running, "queued": s.queued}}]
+    if s.free_pages is not None:
+        events.append({**base, "name": "engine.pages",
+                       "args": {"free": s.free_pages,
+                                "evictable": s.evictable_pages or 0}})
+    events.append({**base, "name": "engine.tokens",
+                   "args": {"prefill": s.prefill_tokens,
+                            "decode": s.decode_tokens,
+                            "prefix_hit": s.prefix_hit_tokens}})
+    events.append({**base, "name": "engine.sched",
+                   "args": {"preemptions": s.preemptions,
+                            "admission_rejections":
+                            s.admission_rejections}})
+    events.append({**base, "name": "engine.host",
+                   "args": {"h2d_uploads": s.h2d_uploads,
+                            "d2h_syncs": s.d2h_syncs,
+                            "dispatches": s.dispatches,
+                            "prefill_chunks": s.prefill_chunks,
+                            "idle_ticks": s.idle_ticks,
+                            "cluster_queue_depth": s.cluster_queue_depth,
+                            "cluster_occupancy": s.cluster_occupancy}})
+    events.append({**base, "name": "engine.overload",
+                   "args": {"spilled_pages": s.spilled_pages,
+                            "restored_pages": s.restored_pages,
+                            "deadline_expirations": s.deadline_expirations,
+                            "queued_critical": s.queued_critical,
+                            "queued_normal": s.queued_normal,
+                            "queued_batch": s.queued_batch}})
+    events.append({**base, "name": "engine.prefix",
+                   "args": {"hits_l0": s.prefix_hits_l0,
+                            "hits_l1": s.prefix_hits_l1,
+                            "hits_l2": s.prefix_hits_l2,
+                            "demotions": s.prefix_demotions,
+                            "promoted_pages": s.prefix_promoted_pages,
+                            "bytes_restored": s.prefix_bytes_restored}})
+    return events
 
 
 def _subtree(tracer: Tracer, root_id: int) -> Set[int]:
@@ -79,53 +126,7 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
         })
     if keep is None:
         for s in tracer.timeline.samples():
-            # tid = replica id (0 outside a cluster): per-replica counter
-            # tracks separate in Perfetto instead of interleaving
-            base = {"ph": "C", "ts": _us(s.ts), "pid": 1,
-                    "tid": s.engine_id}
-            events.append({**base, "name": "engine.seqs",
-                           "args": {"running": s.running,
-                                    "queued": s.queued}})
-            if s.free_pages is not None:
-                events.append({**base, "name": "engine.pages",
-                               "args": {"free": s.free_pages,
-                                        "evictable":
-                                        s.evictable_pages or 0}})
-            events.append({**base, "name": "engine.tokens",
-                           "args": {"prefill": s.prefill_tokens,
-                                    "decode": s.decode_tokens,
-                                    "prefix_hit": s.prefix_hit_tokens}})
-            events.append({**base, "name": "engine.sched",
-                           "args": {"preemptions": s.preemptions,
-                                    "admission_rejections":
-                                    s.admission_rejections}})
-            events.append({**base, "name": "engine.host",
-                           "args": {"h2d_uploads": s.h2d_uploads,
-                                    "d2h_syncs": s.d2h_syncs,
-                                    "dispatches": s.dispatches,
-                                    "prefill_chunks": s.prefill_chunks,
-                                    "idle_ticks": s.idle_ticks,
-                                    "cluster_queue_depth":
-                                    s.cluster_queue_depth,
-                                    "cluster_occupancy":
-                                    s.cluster_occupancy}})
-            events.append({**base, "name": "engine.overload",
-                           "args": {"spilled_pages": s.spilled_pages,
-                                    "restored_pages": s.restored_pages,
-                                    "deadline_expirations":
-                                    s.deadline_expirations,
-                                    "queued_critical": s.queued_critical,
-                                    "queued_normal": s.queued_normal,
-                                    "queued_batch": s.queued_batch}})
-            events.append({**base, "name": "engine.prefix",
-                           "args": {"hits_l0": s.prefix_hits_l0,
-                                    "hits_l1": s.prefix_hits_l1,
-                                    "hits_l2": s.prefix_hits_l2,
-                                    "demotions": s.prefix_demotions,
-                                    "promoted_pages":
-                                    s.prefix_promoted_pages,
-                                    "bytes_restored":
-                                    s.prefix_bytes_restored}})
+            events.extend(_tick_counter_events(s, pid=1))
         # hard-evidence death counter track, synthesized from the
         # watchdog's cluster.health DEAD events (cluster/health.py
         # _mark_dead): one "C" sample per detection, args carry the
@@ -167,12 +168,81 @@ def chrome_trace(tracer: Tracer, root: Optional[int] = None
                                "name": "cluster.fleet_size",
                                "args": {"alive":
                                         int(ev.args["fleet"])}})
+    # fleet tracks: telemetry shipped from out-of-process workers
+    # (Tracer.remote, keyed (replica, incarnation) in ingestion order)
+    # renders as one Chrome pid per worker INCARNATION — a respawn is
+    # visibly a new track.  The Chrome pid is a densified ordinal, never
+    # the OS pid: worker pids change run to run and would break the
+    # merged trace's per-seed byte-identity; the OS pid appears only in
+    # the human-facing "replica/pid/incarnation" track name.
+    remote = getattr(tracer, "remote", None) or {}
+    if keep is None and remote:
+        fleet_pids: Dict[Any, int] = {}
+        for n, ((replica, inc), bucket) in enumerate(remote.items()):
+            pid = 2 + n
+            fleet_pids[(replica, inc)] = pid
+            events.append({
+                "name": "process_name", "ph": "M", "ts": 0, "pid": pid,
+                "tid": 0,
+                "args": {"name": f"{replica}/{pid}/{inc}"}})
+            for sp in bucket["spans"]:
+                t1 = sp.get("t1")
+                args = dict(sp.get("args") or {})
+                if t1 is None:
+                    t1 = sp["t0"]
+                    args["unfinished"] = True
+                events.append({
+                    "name": sp["name"], "cat": sp.get("cat", "app"),
+                    "ph": "X", "ts": _us(sp["t0"]),
+                    "dur": max(0, _us(t1) - _us(sp["t0"])),
+                    "pid": pid, "tid": sp.get("tid", 1),
+                    "id": sp["span_id"], "args": args})
+            for ev in bucket["events"]:
+                events.append({
+                    "name": ev["name"], "cat": "event", "ph": "i",
+                    "s": "t", "ts": _us(ev["ts"]), "pid": pid,
+                    "tid": ev.get("tid", 1), "id": ev["event_id"],
+                    "args": dict(ev.get("args") or {})})
+            for tick in bucket["ticks"]:
+                s = TickSample(**{k: v for k, v in tick.items()
+                                  if k != "k"})
+                events.extend(_tick_counter_events(s, pid=pid))
+        # handoff flows: one Chrome flow arrow per COMMITTED handoff
+        # event (committed = has src+dst and no retry stage), "s" on the
+        # source tier's track and "f" on the destination's, drawn
+        # between the LATEST ingested incarnation of each side — flow
+        # ids are dense 1-based and deterministic in event order
+        flow_id = 0
+        for ev in tracer.events:
+            if (ev.name != "cluster.handoff" or ev.args.get("retried")
+                    or ev.args.get("stage") is not None
+                    or ev.args.get("src") is None
+                    or ev.args.get("dst") is None):
+                continue
+            src_keys = [k for k in remote if k[0] == ev.args["src"]]
+            dst_keys = [k for k in remote if k[0] == ev.args["dst"]]
+            if not src_keys or not dst_keys:
+                continue
+            flow_id += 1
+            for ph, key in (("s", max(src_keys)), ("f", max(dst_keys))):
+                events.append({
+                    "name": "cluster.handoff", "cat": "handoff",
+                    "ph": ph, "ts": _us(ev.ts),
+                    "pid": fleet_pids[key], "tid": 0, "id": flow_id,
+                    "bp": "e", "args": {"run": ev.args.get("run")}})
     # stable sort: equal-ts events keep recording order, so the document
     # is a pure function of the recording (byte-identity under VirtualClock)
     events.sort(key=lambda e: e["ts"])
+    meta: Dict[str, Any] = {"recorder": "k8s_llm_rca_tpu.obs",
+                            "dropped": tracer.dropped}
+    if keep is None and remote:
+        # fleet summary rides the metadata (NOT an event, so a no-fleet
+        # doc stays byte-identical to the pre-fleet exporter)
+        meta["fleet"] = {
+            "workers": len(remote),
+            "shed": sum(b.get("shed", 0) for b in remote.values())}
     return {"traceEvents": events, "displayTimeUnit": "ms",
-            "metadata": {"recorder": "k8s_llm_rca_tpu.obs",
-                         "dropped": tracer.dropped}}
+            "metadata": meta}
 
 
 def chrome_trace_bytes(doc: Dict[str, Any]) -> bytes:
@@ -183,13 +253,21 @@ def chrome_trace_bytes(doc: Dict[str, Any]) -> bytes:
 
 def validate_chrome_trace(doc: Dict[str, Any]) -> int:
     """Structural validation: sorted ``ts``, complete X events (non-negative
-    ``dur``), matched B/E if any ever appear, required keys per phase.
-    Returns the event count; raises ValueError on any violation."""
+    ``dur``), matched B/E if any ever appear, required keys per phase —
+    plus the multi-process shape: every non-parent pid must carry a
+    ``process_name`` "M" metadata event (the per-incarnation track
+    name), and flow events must pair up ("s" start -> "f" finish on one
+    id; "t" steps need an open start) with the unpaired flow id named
+    loudly.  Returns the event count; raises ValueError on violation."""
     events = doc.get("traceEvents")
     if not isinstance(events, list):
         raise ValueError("traceEvents missing or not a list")
     last_ts = None
     open_be: Dict[tuple, int] = {}
+    named_pids: Set[Any] = set()
+    seen_pids: Set[Any] = set()
+    flow_open: Dict[Any, int] = {}
+    flow_done: Set[Any] = set()
     for i, ev in enumerate(events):
         for key in ("name", "ph", "ts", "pid", "tid"):
             if key not in ev:
@@ -198,6 +276,7 @@ def validate_chrome_trace(doc: Dict[str, Any]) -> int:
             raise ValueError(
                 f"event {i} ts {ev['ts']} < previous {last_ts} (unsorted)")
         last_ts = ev["ts"]
+        seen_pids.add(ev["pid"])
         ph = ev["ph"]
         if ph == "X":
             if ev.get("dur", -1) < 0:
@@ -210,11 +289,43 @@ def validate_chrome_trace(doc: Dict[str, Any]) -> int:
             if open_be.get(key, 0) <= 0:
                 raise ValueError(f"E event {i} without matching B: {ev}")
             open_be[key] -= 1
-        elif ph not in ("i", "C", "M"):
+        elif ph in ("s", "t", "f"):
+            if "id" not in ev:
+                raise ValueError(f"flow event {i} missing 'id': {ev}")
+            fid = ev["id"]
+            if ph == "s":
+                if fid in flow_open or fid in flow_done:
+                    raise ValueError(
+                        f"flow event {i} restarts flow id {fid!r} "
+                        f"('s' seen twice)")
+                flow_open[fid] = i
+            elif fid not in flow_open:
+                raise ValueError(
+                    f"flow event {i} ({ph!r}) has unpaired flow id "
+                    f"{fid!r}: no open 's' start")
+            elif ph == "f":
+                del flow_open[fid]
+                flow_done.add(fid)
+        elif ph == "M":
+            if ev["name"] == "process_name":
+                named_pids.add(ev["pid"])
+        elif ph not in ("i", "C"):
             raise ValueError(f"event {i} has unsupported phase {ph!r}")
     dangling = {k: v for k, v in open_be.items() if v}
     if dangling:
         raise ValueError(f"unmatched B events: {dangling}")
+    if flow_open:
+        fid, where = sorted(flow_open.items(), key=lambda kv: kv[1])[0]
+        raise ValueError(
+            f"unpaired flow id {fid!r}: 's' start at event {where} "
+            f"never finished with 'f' ({len(flow_open)} unpaired "
+            f"flow(s) total)")
+    unnamed = {p for p in seen_pids if p != 1 and p not in named_pids}
+    if unnamed:
+        raise ValueError(
+            f"multi-process doc without track metadata: pid(s) "
+            f"{sorted(unnamed, key=str)} carry events but no "
+            f"process_name 'M' metadata event")
     return len(events)
 
 
@@ -259,7 +370,8 @@ class _Family:
              f"# TYPE {self.name} {self.kind}"] + self.samples)
 
 
-def prometheus_text(metrics=None, engine=None, router=None) -> str:
+def prometheus_text(metrics=None, engine=None, router=None,
+                    tracer=None) -> str:
     """Render the Metrics store (+ optional live engine gauges) as
     Prometheus text exposition.  Counters -> ``<name>_total`` counter
     families; phase timers -> summary families (p50 over the retained
@@ -268,7 +380,11 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
     router (cluster.ClusterRouter) -> ``cluster_*`` gauges: replicas
     alive plus per-replica queue depth / occupancy with a ``replica``
     label (the ``cluster.*`` counters — dispatches, failovers, migrated
-    runs — already ride the Metrics store as ``_total`` families)."""
+    runs — already ride the Metrics store as ``_total`` families);
+    tracer -> worker counters shipped over the telemetry seam
+    (Tracer.remote), summed across each replica's incarnations and
+    rendered into the SAME ``_total`` families with ``{replica=}``
+    labels so fleet and parent counters aggregate in one query."""
     if metrics is None:
         from k8s_llm_rca_tpu.utils.logging import METRICS as metrics
 
@@ -462,6 +578,28 @@ def prometheus_text(metrics=None, engine=None, router=None) -> str:
                     if scale_counts[kind]:
                         fam_sc.add(scale_counts[kind],
                                    labels=f'{{kind="{kind}"}}')
+
+    if tracer is not None:
+        remote = getattr(tracer, "remote", None) or {}
+        # shipped worker counters (cluster/proc.py telemetry): a worker
+        # reports its cumulative Metrics snapshot on drain ops; summing
+        # across a replica's incarnations totals the replica's work
+        # including what pre-kill incarnations shipped before dying.
+        # Timer-derived keys (".p50_s" etc.) are skipped: a quantile of
+        # a dead process is not a counter.
+        per_replica: Dict[int, Dict[str, float]] = {}
+        for (replica, _inc), bucket in remote.items():
+            acc = per_replica.setdefault(replica, {})
+            for raw, v in (bucket.get("counters") or {}).items():
+                if raw.endswith((".total_s", ".count", ".p50_s")):
+                    continue
+                acc[raw] = acc.get(raw, 0.0) + float(v)
+        for replica in sorted(per_replica):
+            for raw in sorted(per_replica[replica]):
+                name = f"{_PREFIX}{_sanitize(raw)}_total"
+                family(name, "counter", f"counter {raw!r}").add(
+                    per_replica[replica][raw],
+                    labels=f'{{replica="{replica}"}}')
 
     return "\n".join(families[n].render()
                      for n in sorted(families)) + "\n"

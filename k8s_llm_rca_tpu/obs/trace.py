@@ -38,12 +38,14 @@ not the dashboard).
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import dataclasses
 import itertools
 import threading
 import time as _time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 from k8s_llm_rca_tpu.obs.timeline import TickTimeline
 
@@ -103,10 +105,26 @@ SITES = frozenset({
     # session nonce
     "cluster.net.partition",
     "cluster.net.relink",
+    # fleet flight recorder (cluster/proc.py telemetry shipping): the
+    # WORKER-side span wrapping one handled RPC (recorded in the
+    # worker's own tracer, parented onto the propagated trace context,
+    # ingested parent-side into Tracer.remote), the parent-side event
+    # per non-empty telemetry payload that rode a reply frame, and the
+    # explicit drain flush (ProcBackend.close / watchdog relink heal)
+    "cluster.proc.serve",
+    "cluster.telemetry.ship",
+    "cluster.telemetry.drain",
     # disaggregated tiers (cluster/disagg.py): one event per handoff
     # outcome — a committed EXPORT -> ADOPT -> RELEASE transfer, or a
     # retried attempt discarded whole (args carry the stage and reason)
     "cluster.handoff",
+    # the three phases of one transfer attempt as SPANS around the
+    # actual backend calls (disagg._attempt_handoff), so the
+    # critical-path pass can attribute per-phase handoff time (zero
+    # duration under a VirtualClock, real wire time in production)
+    "cluster.handoff.export",
+    "cluster.handoff.adopt",
+    "cluster.handoff.release",
     # elastic fleet (cluster/autoscale.py): one event per autoscaler
     # action — scale-up spawn, drain-down retirement, or tier rebalance
     # (args carry kind/tier/replica/fleet size/free submeshes)
@@ -124,6 +142,19 @@ SITES = frozenset({
     "resilience.degraded",
     "resilience.breaker_open",
     "resilience.breaker_close",
+    # per-run critical-path segments (obs/critical_path.py): the
+    # decomposition pass emits one event per segment when invoked with
+    # emit=True, so dashboards and the coverage self-check see the
+    # attribution vocabulary alongside the raw spans it is derived from
+    "cp.queue_wait",
+    "cp.prefill",
+    "cp.decode",
+    "cp.handoff.export",
+    "cp.handoff.adopt",
+    "cp.handoff.release",
+    "cp.wire",
+    "cp.relink",
+    "cp.retry",
 })
 
 
@@ -161,13 +192,22 @@ class Tracer:
     single-threaded soak's output reproducible (tid 1 everywhere).
     """
 
-    def __init__(self, clock: Any = None, max_spans: int = 100_000):
+    def __init__(self, clock: Any = None, max_spans: int = 100_000,
+                 trace_id: int = 1):
         self.clock = clock if clock is not None else _time
         self.max_spans = max_spans
+        self.trace_id = int(trace_id)
         self.spans: List[Span] = []
         self.events: List[SpanEvent] = []
         self.dropped = 0
         self.timeline = TickTimeline()
+        # telemetry shipped back from out-of-process workers, keyed
+        # (replica_id, incarnation) in ingestion order — a respawned
+        # worker lands in a NEW bucket, which the Chrome exporter renders
+        # as a visibly new pid track (obs/export.py).  Items stay in wire
+        # form (plain dicts from span_to_wire/event_to_wire/tick_to_wire);
+        # os_pid is recorded for the track name but never used as a key.
+        self.remote: Dict[Tuple[int, int], Dict[str, Any]] = {}
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
         self._local = threading.local()
@@ -254,6 +294,54 @@ class Tracer:
                                          self.now(), self._tid(),
                                          dict(args)))
 
+    # ----------------------------------------------------- fleet propagation
+
+    def context(self, parent: Optional[Span] = None) -> Dict[str, Any]:
+        """Wire-ready propagation context for an outbound request frame:
+        trace id, parent span id (the current thread's innermost open
+        span unless given explicitly), and the injectable clock's NOW so
+        the worker's PropagatedClock stamps its spans in this tracer's
+        (possibly virtual) timebase."""
+        if parent is None:
+            st = self._stack()
+            parent = st[-1] if st else None
+        return {"id": self.trace_id,
+                "parent": parent.span_id if parent is not None else None,
+                "ts": self.now()}
+
+    def ingest_remote(self, replica: int, incarnation: int,
+                      payload: Dict[str, Any]) -> int:
+        """Ingest one telemetry payload shipped off a worker reply frame
+        (cluster/proc.py).  Returns the number of items accepted; ``shed``
+        keeps the worker-reported high-water mark of ring overflow +
+        worker-tracer drops (the at-most-bounded-loss accounting)."""
+        key = (int(replica), int(incarnation))
+        with self._lock:
+            bucket = self.remote.get(key)
+            if bucket is None:
+                bucket = self.remote[key] = {
+                    "os_pid": payload.get("pid"),
+                    "spans": [], "events": [], "ticks": [],
+                    "shed": 0, "counters": {}}
+            n = 0
+            for item in payload.get("items") or ():
+                kind = item.get("k")
+                if kind == "span":
+                    bucket["spans"].append(item)
+                elif kind == "event":
+                    bucket["events"].append(item)
+                elif kind == "tick":
+                    bucket["ticks"].append(item)
+                else:
+                    continue
+                n += 1
+            bucket["shed"] = max(bucket["shed"],
+                                 int(payload.get("shed", 0)))
+            counters = payload.get("counters")
+            if counters:
+                bucket["counters"] = dict(counters)
+        return n
+
     # --------------------------------------------------------------- queries
 
     def mark(self) -> Tuple[int, int, int]:
@@ -266,6 +354,9 @@ class Tracer:
         with self._lock:
             names = {s.name for s in self.spans}
             names |= {e.name for e in self.events}
+            for bucket in self.remote.values():
+                names |= {s["name"] for s in bucket["spans"]}
+                names |= {e["name"] for e in bucket["events"]}
         return names
 
     def flight_summary(self, since: Optional[Tuple[int, int, int]] = None
@@ -293,6 +384,100 @@ class Tracer:
             "duration_s": round(duration, 6),
             "by_name": {k: by_name[k] for k in sorted(by_name)},
         }
+
+
+# ---------------------------------------------------------------------------
+# fleet telemetry: worker-side clock/ring + wire converters
+# ---------------------------------------------------------------------------
+
+
+class PropagatedClock:
+    """Monotone clock pinned to propagated parent timestamps.
+
+    The worker-side tracer (cluster/proc.py) runs under this clock:
+    every request frame's trace context carries the parent tracer's NOW,
+    and ``advance_to`` adopts it, so worker spans and ticks are stamped
+    in the PARENT's timebase — under a frozen ``VirtualClock`` that
+    makes the merged Chrome trace byte-identical per seed instead of
+    polluted by worker wall-clock noise.  Never moves backwards.
+    """
+
+    def __init__(self, t0: float = 0.0):
+        self._t = float(t0)
+
+    def advance_to(self, t: Any) -> None:
+        try:
+            t = float(t)
+        except (TypeError, ValueError):
+            return
+        if t > self._t:
+            self._t = t
+
+    def time(self) -> float:
+        return self._t
+
+    def sleep(self, seconds: float) -> None:
+        # clock-protocol parity with VirtualClock: advancing is the only
+        # honest "sleep" a propagated timebase can offer
+        self._t += float(seconds)
+
+
+class TelemetryRing:
+    """Bounded FIFO of wire-ready telemetry items (the worker half of
+    telemetry shipping, cluster/proc.py).
+
+    ``push`` past capacity drops the OLDEST item and counts it in
+    ``shed`` — after a SIGKILL the newest pre-kill activity is the part
+    an RCA needs, so the ring sheds history, not the tail.  ``pop``
+    drains at most ``budget`` items in FIFO order (the per-reply-frame
+    piggyback budget keeps frames bounded under wire.MAX_FRAME_SIZE).
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"TelemetryRing capacity must be >= 1, "
+                             f"got {capacity}")
+        self.capacity = int(capacity)
+        self.shed = 0
+        self._items: Deque[Dict[str, Any]] = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def push(self, item: Dict[str, Any]) -> None:
+        if len(self._items) >= self.capacity:
+            self._items.popleft()
+            self.shed += 1
+        self._items.append(item)
+
+    def pop(self, budget: int) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        while self._items and len(out) < budget:
+            out.append(self._items.popleft())
+        return out
+
+
+def span_to_wire(sp: Span) -> Dict[str, Any]:
+    """Wire form of a completed span — plain JSON-safe dict with a ``k``
+    discriminator, ingested as-is by ``Tracer.ingest_remote``."""
+    return {"k": "span", "name": sp.name, "cat": sp.cat,
+            "span_id": sp.span_id, "parent_id": sp.parent_id,
+            "t0": sp.t0, "t1": sp.t1, "tid": sp.tid,
+            "args": dict(sp.args)}
+
+
+def event_to_wire(ev: SpanEvent) -> Dict[str, Any]:
+    return {"k": "event", "name": ev.name, "event_id": ev.event_id,
+            "parent_id": ev.parent_id, "ts": ev.ts, "tid": ev.tid,
+            "args": dict(ev.args)}
+
+
+def tick_to_wire(sample: Any) -> Dict[str, Any]:
+    """Wire form of a TickSample (obs/timeline.py) — every field is
+    already a JSON scalar, so asdict + discriminator suffices."""
+    d = dataclasses.asdict(sample)
+    d["k"] = "tick"
+    return d
 
 
 # ---------------------------------------------------------------------------

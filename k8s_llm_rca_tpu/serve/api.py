@@ -38,7 +38,7 @@ import itertools
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from k8s_llm_rca_tpu.obs import trace as obs_trace
 from k8s_llm_rca_tpu.serve.backend import GenOptions, LMBackend
@@ -401,20 +401,38 @@ class AssistantService:
         return usage
 
     @_locked
-    def usage_for_runs(self, run_ids: Sequence[str]) -> Dict[str, int]:
+    def usage_for_runs(self, run_ids: Sequence[str],
+                       critical_path: bool = False) -> Dict[str, Any]:
         """Exact usage attribution: sum the usage of precisely the named
         runs (terminal only — in-flight usage is still moving).  The
         wall-clock window of ``assistant_token_usage`` double-counts when
         incidents overlap in time (pipelined sweeps); summing by the run
         ids an incident actually created cannot.  Same 3-key schema as the
-        reference's windowed accounting."""
-        usage = {"prompt_tokens": 0, "completion_tokens": 0,
-                 "total_tokens": 0}
+        reference's windowed accounting.
+
+        ``critical_path=True`` additionally attaches the per-run latency
+        decomposition (obs/critical_path.py over the ACTIVE tracer's
+        merged fleet tree) under a ``"critical_path"`` key.  Strictly
+        opt-in: the default 3-key schema is embedded in the pipelined
+        sweep's byte-compared ``report_bytes`` and must never change
+        shape."""
+        usage: Dict[str, Any] = {"prompt_tokens": 0,
+                                 "completion_tokens": 0,
+                                 "total_tokens": 0}
         for rid in run_ids:
             run = self.runs.get(rid)
             if run is not None and run.status in RunStatus.TERMINAL:
-                for k in usage:
+                for k in ("prompt_tokens", "completion_tokens",
+                          "total_tokens"):
                     usage[k] += run.usage[k]
+        if critical_path:
+            from k8s_llm_rca_tpu.obs.critical_path import (
+                critical_path as _decompose)
+
+            tr = obs_trace._ACTIVE
+            usage["critical_path"] = (
+                _decompose(tr, runs=set(run_ids)) if tr is not None
+                else {})
         return usage
 
     @_locked
@@ -437,14 +455,18 @@ class AssistantService:
         return this string with content type text/plain; version=0.0.4.
         A cluster backend (cluster.ClusterRouter — duck-typed on its
         ``queue_depths`` accessor) additionally yields ``cluster_*``
-        gauges: replicas alive, per-replica queue depth and occupancy."""
+        gauges: replicas alive, per-replica queue depth and occupancy.
+        Under an active tracer, worker counters shipped over the fleet
+        telemetry seam render into the same families with ``{replica=}``
+        labels."""
         from k8s_llm_rca_tpu.obs.export import prometheus_text
 
         router = (self.backend
                   if hasattr(self.backend, "queue_depths") else None)
         return prometheus_text(METRICS,
                                engine=getattr(self.backend, "engine", None),
-                               router=router)
+                               router=router,
+                               tracer=obs_trace._ACTIVE)
 
     # ------------------------------------------------------------ execution
 

@@ -797,12 +797,42 @@ class TestSiteCoverage:
             assert up["kind"] == "up" and down["kind"] == "down"
         assert "cluster.scale" in tr_scale.emitted_names()
 
+        # (13) fleet-telemetry + critical-path sites: ONE worker spawned
+        # with the flight recorder on — its cluster.proc.serve spans
+        # ship back piggybacked on reply frames (cluster.telemetry.ship)
+        # and close() flushes the ring (cluster.telemetry.drain); the
+        # handoff PHASE spans (cluster.handoff.export/adopt/release,
+        # disagg._attempt_handoff) already fired in segment (11).  Then
+        # the critical-path pass re-emits its cp.* segment vocabulary
+        # over the recorded serve.run spans (obs/critical_path.py)
+        from k8s_llm_rca_tpu.obs import critical_path
+
+        tr_fleet = Tracer(clock=VirtualClock())
+        tracers.append(tr_fleet)
+        with obs_trace.tracing(tr_fleet):
+            (tel_replica,) = build_proc_replicas(1, kind="oracle",
+                                                 trace=True)
+            try:
+                ht = tel_replica.backend.start("node notready",
+                                               GenOptions())
+                for _ in range(20):
+                    if ht in tel_replica.backend.pump():
+                        break
+            finally:
+                tel_replica.close()
+            tr_fleet.add_span("serve.run", 0.0, tr_fleet.now(),
+                              cat="serve", args={"run": "cover-cp",
+                                                 "status": "completed"})
+            assert critical_path(tr_fleet, emit=True)
+        assert {"cluster.proc.serve", "cluster.telemetry.ship",
+                "cluster.telemetry.drain"} <= tr_fleet.emitted_names()
+
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
         # and the registry is the full emitted vocabulary for our names:
         # anything we emit under a known prefix must be registered
         prefixes = ("engine.", "serve.", "backend.", "graph.", "rca.",
-                    "resilience.", "cluster.")
+                    "resilience.", "cluster.", "cp.")
         emitted = set()
         for tr in tracers:
             emitted |= tr.emitted_names()
