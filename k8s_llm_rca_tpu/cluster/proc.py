@@ -202,8 +202,23 @@ def _build_worker_backend(spec: Dict[str, Any]):
         params = llama.init_params(cfg,
                                    jax.random.PRNGKey(spec.get("seed", 0)))
         tok = get_tokenizer(vocab_size=cfg.vocab_size)
+        # cache-fabric attachment (docs/cluster.md "Cache fabric"): a
+        # ``store_addr`` [host, port] in the spec dials the shared
+        # cross-host StoreServer and plugs it in as the engine's prefix
+        # store — the same PrefixStore surface the in-process tiers use,
+        # so warm starts / store-backed restores work identically from a
+        # worker process.  A dead store degrades every op to a counted
+        # cold miss (cluster/store.py failure contract), so worker
+        # byte-parity never depends on the fabric's health.
+        store = None
+        if spec.get("store_addr") is not None:
+            from k8s_llm_rca_tpu.cluster.store import RemoteStore
+
+            host, port = spec["store_addr"]
+            store = RemoteStore(addr=(str(host), int(port)))
         backend = EngineBackend(make_engine(cfg, ecfg, params, tok,
-                                            use_kernel=False))
+                                            use_kernel=False,
+                                            prefix_store=store))
         return backend, (lambda: int(backend.engine.heartbeat))
     raise ValueError(f"unknown proc worker kind {kind!r}: expected one "
                      f"of {WORKER_KINDS}")

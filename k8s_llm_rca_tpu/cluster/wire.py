@@ -43,13 +43,12 @@ from __future__ import annotations
 import io
 import json
 import select
-import struct
 import zlib
 from typing import Any, Dict, Optional
 
 from k8s_llm_rca_tpu.utils import wal
 
-HEADER = struct.Struct(">II")           # (length, crc32) — wal._HEADER twin
+HEADER = wal.HEADER                     # (length, crc32) — THE shared header
 HEADER_SIZE = wal.HEADER_SIZE
 MAX_FRAME_SIZE = wal.MAX_RECORD_SIZE
 _CHUNK = 65536

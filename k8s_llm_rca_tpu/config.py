@@ -270,6 +270,30 @@ class EngineConfig:
     prefix_host_pages: int = 0
     prefix_disk_dir: Optional[str] = None
     prefix_disk_pages: int = 0
+    # pressure-driven demotion (paged engine only; docs/performance.md
+    # "cache fabric"): when > 0, an HBM high-water mark in PAGES — at
+    # every tick boundary where the allocator's free-page count dips
+    # below it, refcount-0 prefix pages demote autonomously through the
+    # same coalesced ``_demote`` gather explicit eviction uses, oldest
+    # first, until the watermark is restored (or the evictable set runs
+    # dry).  Engines keep hot pages resident under production load with
+    # no router intervention; with a store attached the demoted pages
+    # stay promotable, without one this is plain pressure eviction.
+    # Requires ``prefix_cache=True``; excluded (loud ValueError) on the
+    # contiguous engine and for negative / over-capacity (>= num_pages)
+    # values.  0 = off (explicit evict only, today's behavior).
+    prefix_hbm_watermark: int = 0
+    # store-backed instant recovery (paged engine only, requires a
+    # tiered/remote store; docs/durability.md "store-backed restore"):
+    # when True, every tick that grew the prefix cache also publishes
+    # the newly-resident full-page chains to the store WITHOUT freeing
+    # them (``PrefixCache.flush_to_store``), so a crash-restart, drain
+    # migration or disagg prefill-death fallback on ANOTHER engine
+    # re-prefills against a warm fabric — near-instant, promote-then-
+    # adopt, spill-identical bucket math.  Excluded (loud ValueError)
+    # without a store: write-through with nowhere to write is a config
+    # bug, not a degraded mode.
+    prefix_store_writethrough: bool = False
 
 
 @dataclass(frozen=True)

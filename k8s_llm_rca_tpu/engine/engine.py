@@ -1211,6 +1211,10 @@ class EngineBase:
                                         0.0),
             prefix_bytes_restored=c.get("engine.prefix_bytes_restored",
                                         0.0),
+            prefix_store_misses_remote=c.get(
+                "engine.prefix_store_misses_remote", 0.0),
+            prefix_watermark_demotions=c.get(
+                "engine.prefix_watermark_demotions", 0.0),
             idle_ticks=c.get("engine.idle_ticks", 0.0),
             queued_critical=g.get("queued_critical", 0),
             queued_normal=g.get("queued_normal", 0),
@@ -1737,6 +1741,19 @@ class InferenceEngine(EngineBase):
                 "cache has no page pool to demote prefix pages from or "
                 "promote them into.  Use paged=True "
                 "(PagedInferenceEngine) or leave the tier knobs unset")
+        if engine_cfg.prefix_hbm_watermark:
+            raise ValueError(
+                "prefix_hbm_watermark (pressure-driven prefix demotion) "
+                "requires the paged engine: the contiguous cache has no "
+                "page allocator whose free count could dip below a "
+                "watermark.  Use paged=True (PagedInferenceEngine) or "
+                "prefix_hbm_watermark=0")
+        if engine_cfg.prefix_store_writethrough:
+            raise ValueError(
+                "prefix_store_writethrough requires the paged engine "
+                "and a store: the contiguous cache has no prefix pages "
+                "to publish.  Use paged=True (PagedInferenceEngine) or "
+                "prefix_store_writethrough=False")
         if cp_mesh is not None:
             validate_cp_divisibility(
                 cp_seq_axis, cp_mesh.shape[cp_seq_axis],

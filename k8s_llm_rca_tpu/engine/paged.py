@@ -53,7 +53,7 @@ from k8s_llm_rca_tpu.ops.paged_attention import (
     paged_attention_sharded, paged_attention_xla,
 )
 from k8s_llm_rca_tpu.engine.prefix import (
-    CACHE_OWNER, PrefixCache, PrefixStore,
+    CACHE_OWNER, PrefixCache, PrefixStore, _page_keys,
 )
 from k8s_llm_rca_tpu.ops.rope import rope_frequencies
 from k8s_llm_rca_tpu.runtime import profiling
@@ -1063,6 +1063,34 @@ class PagedInferenceEngine(EngineBase):
                     "deterministically on every process — the same "
                     "physics as the max_spilled_pages exclusion; serve "
                     "PP engines without the prefix tier knobs")
+        if engine_cfg.prefix_hbm_watermark:
+            if engine_cfg.prefix_hbm_watermark < 0:
+                raise ValueError(
+                    f"prefix_hbm_watermark="
+                    f"{engine_cfg.prefix_hbm_watermark} must be >= 0 "
+                    f"(0 disables pressure-driven demotion)")
+            if not engine_cfg.prefix_cache:
+                raise ValueError(
+                    "prefix_hbm_watermark requires prefix_cache=True: "
+                    "pressure-driven demotion frees refcount-0 PREFIX "
+                    "pages — without a prefix cache there is nothing "
+                    "evictable to demote")
+            if engine_cfg.prefix_hbm_watermark >= engine_cfg.num_pages:
+                raise ValueError(
+                    f"prefix_hbm_watermark="
+                    f"{engine_cfg.prefix_hbm_watermark} is over capacity "
+                    f"(num_pages={engine_cfg.num_pages}): a watermark at "
+                    f"or above the whole pool demotes every cached page "
+                    f"the moment one sequence admits — the policy "
+                    f"degenerates to prefix_cache=False with extra "
+                    f"gathers; pick a watermark below num_pages")
+        if engine_cfg.prefix_store_writethrough and not tiered:
+            raise ValueError(
+                "prefix_store_writethrough=True without a store "
+                "(prefix_host_pages / prefix_disk_dir / prefix_disk_pages "
+                "/ a shared prefix_store): write-through publishes "
+                "resident chains TO a store — with nowhere to write it "
+                "is a config bug, not a degraded mode")
         self._cp_parts = 0
         if cp_mesh is not None:
             if engine_cfg.prefix_cache:
@@ -1226,6 +1254,12 @@ class PagedInferenceEngine(EngineBase):
                 host_pages=engine_cfg.prefix_host_pages,
                 disk_dir=engine_cfg.prefix_disk_dir,
                 disk_pages=engine_cfg.prefix_disk_pages)
+        if self.prefix_store is not None and hasattr(self.prefix_store,
+                                                     "bind_count"):
+            # a RemoteStore (cluster/store.py) counts its degraded ops
+            # through the engine's _count so misses reach TickSample /
+            # Chrome / Prometheus alongside the other prefix counters
+            self.prefix_store.bind_count(self._count)
         self.prefix_cache = (
             PrefixCache(self.allocator, self.page_size,
                         store=self.prefix_store,
@@ -1233,6 +1267,14 @@ class PagedInferenceEngine(EngineBase):
                         promote=self._promote_prefix_records,
                         count=self._count)
             if engine_cfg.prefix_cache else None)
+        # pressure-driven demotion + write-through (docs/performance.md
+        # "cache fabric"): both act at tick boundaries in the eviction
+        # phase; _wt_resident tracks the last flushed resident count so
+        # write-through only pays a store sweep on growth
+        self._hbm_watermark = int(engine_cfg.prefix_hbm_watermark)
+        self._writethrough = bool(engine_cfg.prefix_store_writethrough
+                                  and self.prefix_store is not None)
+        self._wt_resident = 0
 
         self.block_tables = np.full((b, self.pages_per_seq), TRASH_PAGE,
                                     np.int32)
@@ -1628,6 +1670,7 @@ class PagedInferenceEngine(EngineBase):
             return finished
 
         with profiling.annotate("engine.tick.eviction"):
+            self._tick_pressure()
             self._tick_growth()
         active_slots = sorted(self._active)
         if not active_slots:
@@ -1770,6 +1813,42 @@ class PagedInferenceEngine(EngineBase):
             del self._pending[:len(group)]
             finished.extend(admitted)
         return finished
+
+    def _tick_pressure(self) -> None:
+        """Pressure-driven demotion + write-through, both tick-boundary
+        policies on the prefix cache (EngineConfig.prefix_hbm_watermark /
+        prefix_store_writethrough; docs/performance.md "cache fabric").
+
+        Watermark: when the allocator's free count dips below the mark,
+        refcount-0 prefix pages demote through the SAME coalesced
+        ``PrefixCache.evict`` -> ``_demote_prefix_pages`` gather that
+        explicit eviction uses (oldest chains first), until the mark is
+        restored or the evictable set runs dry — so growth/admission in
+        the SAME tick already sees the freed pages.  Write-through: when
+        the resident set grew since the last flush, newly-inserted full-
+        page chains are published to the store WITHOUT freeing them
+        (``flush_to_store``), which is what makes another engine's
+        crash-restart / drain / disagg-fallback re-prefill a store hit.
+        Reading prefix pages without an overlap barrier is safe: cache
+        pages are refcount-shared read-only — in-flight decode steps
+        write only to active slots' private current pages."""
+        if self.prefix_cache is None:
+            return
+        if self._hbm_watermark:
+            deficit = self._hbm_watermark - self.allocator.n_free
+            if deficit > 0:
+                demoted = self.prefix_cache.evict(deficit)
+                if demoted:
+                    self._count("engine.prefix_watermark_demotions",
+                                demoted)
+        if self._writethrough:
+            resident = self.prefix_cache.n_resident
+            if resident != self._wt_resident:
+                flushed = self.prefix_cache.flush_to_store()
+                self._wt_resident = resident
+                if flushed:
+                    self._count("engine.prefix_writethrough_pages",
+                                flushed)
 
     def _tick_growth(self) -> None:
         # grow block tables to cover this tick's scan window: the
@@ -2294,7 +2373,13 @@ class PagedInferenceEngine(EngineBase):
         as a pending-style entry (original prompt, nothing generated) —
         its written pages are device state a restart cannot reuse, so
         restore re-admits it through a fresh prefill, between the active
-        sequences and the pending queue (its scheduler position)."""
+        sequences and the pending queue (its scheduler position).
+
+        With a shared store attached, active sequences' written pages
+        are published first (``_publish_sequence_pages``) so whoever
+        restores this snapshot — a restarted incarnation, a drain
+        target, a disagg fallback — promotes instead of recomputing."""
+        self._publish_sequence_pages()
         snap = super().snapshot_sequences()
         if not self._prefilling:
             return snap
@@ -2652,6 +2737,60 @@ class PagedInferenceEngine(EngineBase):
             return 0
         self._overlap_barrier()
         return self.prefix_cache.flush_to_store(limit)
+
+    def _publish_sequence_pages(self) -> int:
+        """Store-backed instant recovery, the publish half
+        (docs/durability.md "store-backed restore"): push every ACTIVE
+        sequence's full written pages — prompt AND generated-so-far, not
+        just the cached prefix chains — into the shared store, keyed by
+        the same chained page digests ``PrefixCache.match`` probes.
+
+        Called by ``snapshot_sequences`` (so crash snapshots and drain
+        migrations leave a warm fabric behind) and harmless without a
+        store (returns 0).  The restore side needs NO new machinery:
+        ``restore_sequences`` re-admits through a normal prefill of
+        prompt + generated, and tier-aware ``match`` promotes these
+        pages back — spill-identical bucket math (``suffix_bucket``),
+        one h2d scatter, re-prefilling only the sub-page tail.  ONE
+        coalesced d2h gather for the whole publish set; already-stored
+        digests and pages shared between sequences are skipped."""
+        if self.prefix_cache is None or self.prefix_store is None:
+            return 0
+        self._overlap_barrier()
+        resumed = self._resumed or {}
+        P = self.page_size
+        pend_pages: List[int] = []
+        pend_keys: List[bytes] = []
+        seen = set()
+        for slot in sorted(self._active):
+            st = self._active[slot]
+            n_full = int(self.lengths[slot]) // P
+            if n_full <= 0:
+                continue
+            tokens = (list(self._prompts.get(st.seq_id, []))
+                      + list(resumed.get(st.seq_id, ()))
+                      + list(st.generated))
+            if len(tokens) < n_full * P:
+                continue            # defensive: mirrors out of sync
+            keys = _page_keys(tokens, n_full, P)
+            table = self.block_tables[slot]
+            for i, key in enumerate(keys):
+                page = int(table[i])
+                if page == TRASH_PAGE or key in seen:
+                    continue
+                seen.add(key)
+                if self.prefix_store.contains(key):
+                    continue
+                pend_pages.append(page)
+                pend_keys.append(key)
+        if not pend_pages:
+            return 0
+        with profiling.annotate("engine.prefix_publish"):
+            rec = gather_pages(self.pool, self._fetch, pend_pages)
+            for key, page_rec in zip(pend_keys, split_pages(rec)):
+                self.prefix_store.put(key, page_rec)
+        self._count("engine.prefix_snapshot_published", len(pend_keys))
+        return len(pend_keys)
 
     def _maybe_spill(self, slot: int, st: _Active,
                      budget_exempt: bool = False) -> bool:

@@ -54,7 +54,17 @@ Sites in the real stack:
   ADOPT ack loses the fencing race and the adopted twin is cancelled),
   plus the killer's crash/partition/halfopen landing exactly between
   EXPORT and ADOPT.  Own-plan discipline: polled once per transfer
-  attempt from the handoff plan, never from the armed chaos plan.
+  attempt from the handoff plan, never from the armed chaos plan;
+- ``SITE_STORE`` (``cluster/store.py::RemoteStore`` +
+  ``faults/supervisor.py::StoreKiller``): faults on the cross-host
+  prefix-store fabric — "drop" (the store op silently never happens),
+  "corrupt" (one payload byte flipped, so the CRC/record decoder
+  rejects it downstream), "delay" (virtual-clock RPC latency),
+  "partition" (the store link stays severed until a "heal" fault),
+  plus the killer's SIGKILL/respawn of the store server itself.  Every
+  one degrades to a counted cold miss, never an engine error.
+  Own-plan discipline: polled exactly once per store op from the
+  store's plan, never from the armed chaos plan.
 """
 
 from __future__ import annotations
@@ -72,6 +82,7 @@ SITE_REPLICA = "cluster.replica"
 SITE_PROC = "cluster.proc"
 SITE_NET = "cluster.net"
 SITE_HANDOFF = "cluster.handoff"
+SITE_STORE = "cluster.store"
 
 # the armed plan; hot paths read this directly (see module docstring)
 _ARMED: Optional[FaultPlan] = None

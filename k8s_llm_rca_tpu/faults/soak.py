@@ -272,7 +272,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                    concurrency: int = 1,
                    tier_split: Optional[Tuple[int, int]] = None,
                    handoff_plan: Optional[FaultPlan] = None,
-                   fleet_telemetry: bool = False
+                   fleet_telemetry: bool = False,
+                   store_fabric: Optional[Any] = None
                    ) -> Dict[str, Any]:
     """Drive ``n_incidents`` of the canned corpus through the resilient
     pipeline under an armed FaultPlan; return the deterministic report.
@@ -346,6 +347,19 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
     After the sweep the router is pumped a few extra (plan-free) times
     so a wedge landed at the last boundary still heals before the
     engine-clean check.
+
+    ``store_fabric``: optional cluster.store.StoreFabric (build via
+    ``build_store_fabric``) — attaches the cross-host prefix-store
+    service to the soak.  Exercised exactly once per incident on both
+    outcome paths (one put/get round trip through the live server);
+    every outcome — hit, miss, dead store — lands ONLY in the fabric's
+    own counters, never in the report, so ``report_bytes`` stays
+    byte-identical to the store-less run (the cache-fabric acceptance
+    bar).  A ``StoreKiller`` in ``killer`` is bound to this fabric and
+    SIGKILLs/respawns the real store process at incident boundaries on
+    its OWN plan; passing a StoreKiller WITHOUT a fabric, or putting
+    SITE_STORE in the armed ``plan_spec`` (it belongs on the store's
+    own plan), is refused loudly before any worker spawns.
 
     ``concurrency``: incidents in flight at once (rca/scheduler.py).  At
     1 (the default) the historical sequential loop runs unchanged.
@@ -429,6 +443,25 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
         n_prefill = max(1, (cluster_replicas + 1) // 2)
         tier_split = (n_prefill, cluster_replicas - n_prefill)
 
+    # store-fabric validation BEFORE any worker spawns (same leak
+    # discipline as the killer checks below): SITE_STORE belongs on the
+    # STORE's own plan — an armed chaos plan polling it would shift the
+    # armed plan's poll counters with every store op and the fabric run
+    # could never settle byte-identical to the store-less run
+    if plan_spec and inject.SITE_STORE in plan_spec:
+        raise ValueError(
+            f"plan_spec must not schedule {inject.SITE_STORE!r}: store "
+            f"faults are polled from the RemoteStore's OWN plan "
+            f"(cluster.store.RemoteStore(plan=...)), never from the "
+            f"armed chaos plan — build the fabric with its own "
+            f"FaultPlan and pass it as store_fabric")
+    if store_fabric is not None and concurrency > 1:
+        raise ValueError(
+            "store_fabric is exercised once per incident BOUNDARY — a "
+            "pipelined sweep has no global incident order, so the "
+            "fabric's op schedule could never match the sequential "
+            "run; concurrency > 1 requires store_fabric=None")
+
     # killer-list validation BEFORE any worker spawns: a ValueError here
     # must not leak subprocesses (_reaping_workers is not entered yet)
     killers: List[Any] = []
@@ -446,6 +479,7 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                 f"one site would double-count its plan per incident and "
                 f"the kill schedule could never match a single-killer "
                 f"run")
+        from k8s_llm_rca_tpu.faults.supervisor import StoreKiller
         for k in killers:
             if (isinstance(k, HandoffKiller)
                     and backend != "disagg-cluster"):
@@ -453,6 +487,14 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                     f"HandoffKiller requires backend='disagg-cluster' "
                     f"(got {backend!r}): its kill window only opens "
                     f"between EXPORT and ADOPT of a TierRouter handoff")
+            if isinstance(k, StoreKiller):
+                if store_fabric is None:
+                    raise ValueError(
+                        "StoreKiller requires store_fabric: there is no "
+                        "remote store process to SIGKILL — build one "
+                        "with cluster.store.build_store_fabric and pass "
+                        "it as store_fabric")
+                k.store = store_fabric
 
     router = None
     if backend == "engine":
@@ -583,6 +625,8 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                             clock)
                     for k in killers:
                         k.checkpoint()
+                    if store_fabric is not None:
+                        store_fabric.exercise(i)
                     continue
                 row = _incident_row(message, result)
                 if row["status"] == "degraded":
@@ -604,6 +648,13 @@ def run_chaos_soak(seed: int = 0, n_incidents: int = 3,
                 # multi-killer schedule is a pure function of the plans
                 for k in killers:
                     k.checkpoint()
+                # fabric traffic AFTER the killer boundary, so a store
+                # killed at boundary i is exercised dead during incident
+                # i (counted cold misses on the fabric object) and a
+                # heal at a later boundary restores hits — the report
+                # never sees either (byte-identity bar)
+                if store_fabric is not None:
+                    store_fabric.exercise(i)
 
         if router is not None and router.health is not None:
             # kill-and-heal drain: a wedge landed at the LAST incident

@@ -474,3 +474,78 @@ class HandoffKiller(ReplicaKiller):
                     "prefill" if victim == src_rid else "decode",
                     ghandle)
         return victim
+
+
+class StoreKiller:
+    """Kill and heal the cross-host prefix-store server
+    (cluster/store.py) at incident boundaries.
+
+    Not a ReplicaKiller subclass on purpose: the store is a shared
+    DEPENDENCY, not a fleet member — killing it must never remove a
+    replica, fail a run, or touch the router at all.  The whole point of
+    the fabric's failure contract is that the sweep's report bytes are
+    identical with the store alive, dead, or flapping; this killer is
+    how the soak proves it.
+
+    Discipline matches the other incident-boundary killers:
+    ``checkpoint()`` polls this killer's OWN FaultPlan exactly once per
+    incident at ``inject.SITE_STORE`` (never the armed chaos plan, so a
+    store death cannot perturb any other site's schedule).  Fault kinds:
+    "crash" (SIGKILL the store server — L1 dies with it, L2 ``.page``
+    files survive for the next incarnation) and "heal" (respawn it,
+    same address when the port can be rebound).  While dead, every
+    store op in the fleet degrades to a counted cold miss
+    (``engine.prefix_store_misses_remote``) — zero engine errors.
+
+    ``store`` may be a ``StoreServer`` or a ``StoreFabric`` (the soak
+    binds the fabric's server after construction, mirroring the
+    ``killer.router = r`` idiom)."""
+
+    site = inject.SITE_STORE
+
+    def __init__(self, plan: FaultPlan, store=None):
+        self.plan = plan
+        self.store = store
+        self.router = None     # bound by the soak for uniformity; unused
+        self.kills: List[int] = []
+        self.heals: List[int] = []
+        self._incident = -1
+
+    def _server(self):
+        server = getattr(self.store, "server", self.store)
+        if server is None:
+            raise ValueError(
+                "StoreKiller has no store bound: attach a StoreFabric/"
+                "StoreServer (run_chaos_soak does this when "
+                "store_fabric= is passed) before the sweep starts")
+        return server
+
+    def checkpoint(self) -> Optional[int]:
+        """One boundary poll; returns the incident index on a kill."""
+        self._incident += 1
+        fault = self.plan.poll(self.site)
+        if fault is None:
+            return None
+        server = self._server()
+        if fault.kind == "crash":
+            server.kill()
+            self.kills.append(self._incident)
+            METRICS.inc("faults.store_kills")
+            log.warning("store kill #%d: server pid %s SIGKILLed at "
+                        "incident %d (fleet degrades to cold misses)",
+                        len(self.kills), server.pid, self._incident)
+            return self._incident
+        if fault.kind == "heal":
+            server.respawn()
+            self.heals.append(self._incident)
+            METRICS.inc("faults.store_heals")
+            log.warning("store heal #%d: server respawned as pid %s "
+                        "(incarnation %d) at incident %d",
+                        len(self.heals), server.pid, server.incarnation,
+                        self._incident)
+            return None
+        log.warning("store fault %r ignored: only 'crash'/'heal' are "
+                    "meaningful at %s (op kinds drop/corrupt/delay/"
+                    "partition belong on the RemoteStore's own "
+                    "store plan)", fault.kind, self.site)
+        return None

@@ -78,7 +78,11 @@ def _tick_counter_events(s, pid: int) -> List[Dict[str, Any]]:
                             "hits_l2": s.prefix_hits_l2,
                             "demotions": s.prefix_demotions,
                             "promoted_pages": s.prefix_promoted_pages,
-                            "bytes_restored": s.prefix_bytes_restored}})
+                            "bytes_restored": s.prefix_bytes_restored,
+                            "store_misses_remote":
+                            s.prefix_store_misses_remote,
+                            "watermark_demotions":
+                            s.prefix_watermark_demotions}})
     return events
 
 
@@ -371,7 +375,7 @@ class _Family:
 
 
 def prometheus_text(metrics=None, engine=None, router=None,
-                    tracer=None) -> str:
+                    tracer=None, store=None) -> str:
     """Render the Metrics store (+ optional live engine gauges) as
     Prometheus text exposition.  Counters -> ``<name>_total`` counter
     families; phase timers -> summary families (p50 over the retained
@@ -384,7 +388,13 @@ def prometheus_text(metrics=None, engine=None, router=None,
     tracer -> worker counters shipped over the telemetry seam
     (Tracer.remote), summed across each replica's incarnations and
     rendered into the SAME ``_total`` families with ``{replica=}``
-    labels so fleet and parent counters aggregate in one query."""
+    labels so fleet and parent counters aggregate in one query;
+    store (cluster.store RemoteStore/StoreServer — anything with a
+    ``.stats()`` RPC) -> ``cluster_store_*`` families: hits as a
+    labeled ``cluster_store_hits_total{tier=}`` counter plus op/health
+    gauges.  A dead store renders NOTHING (stats() degrades to ``{}``
+    by the fabric's cold-miss contract) — absence of the families IS
+    the outage signal, and scraping never errors."""
     if metrics is None:
         from k8s_llm_rca_tpu.utils.logging import METRICS as metrics
 
@@ -600,6 +610,31 @@ def prometheus_text(metrics=None, engine=None, router=None,
                 family(name, "counter", f"counter {raw!r}").add(
                     per_replica[replica][raw],
                     labels=f'{{replica="{replica}"}}')
+
+    if store is not None:
+        # one stats() RPC against the live store server; {} when the
+        # server is dead/partitioned (RemoteStore.stats never raises)
+        stats = {}
+        stats_fn = getattr(store, "stats", None)
+        if stats_fn is not None:
+            stats = stats_fn() or {}
+        if stats:
+            fam_hits = family(
+                f"{_PREFIX}cluster_store_hits_total", "counter",
+                "prefix-store fabric gets served, by tier "
+                "(l1=host-RAM, l2=disk)")
+            for tier in ("l1", "l2"):
+                fam_hits.add(stats.get(f"hits_{tier}", 0.0),
+                             labels=f'{{tier="{tier}"}}')
+            for key, help_text in (
+                    ("puts", "fabric put ops accepted"),
+                    ("gets", "fabric get ops answered"),
+                    ("misses", "fabric gets answered cold"),
+                    ("rejected", "fabric puts refused (CRC/size)"),
+                    ("n_host", "pages resident in the host-RAM tier"),
+                    ("n_disk", "pages resident in the disk tier")):
+                family(f"{_PREFIX}cluster_store_{key}", "gauge",
+                       help_text).add(stats.get(key, 0.0))
 
     return "\n".join(families[n].render()
                      for n in sorted(families)) + "\n"

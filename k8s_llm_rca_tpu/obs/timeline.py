@@ -78,6 +78,13 @@ class TickSample:
     prefix_demotions: float = 0.0
     prefix_promoted_pages: float = 0.0
     prefix_bytes_restored: float = 0.0
+    # cache fabric (docs/cluster.md "Cache fabric"): cumulative store
+    # ops that silently degraded to cold misses (a dead / partitioned /
+    # faulted RemoteStore — the fabric's only failure mode) and pages
+    # demoted autonomously because free HBM pages dipped below
+    # EngineConfig.prefix_hbm_watermark at a tick boundary
+    prefix_store_misses_remote: float = 0.0
+    prefix_watermark_demotions: float = 0.0
     # pipelined sweep (serve/backend.py): cumulative pumps that found
     # live handles but nothing decodable — the WAITED ticks the sweep
     # scheduler exists to eliminate (docs/performance.md "Pipelined

@@ -129,6 +129,15 @@ SITES = frozenset({
     # action — scale-up spawn, drain-down retirement, or tier rebalance
     # (args carry kind/tier/replica/fleet size/free submeshes)
     "cluster.scale",
+    # cache fabric (cluster/store.py): one event per SUCCESSFUL store op
+    # from the client (RemoteStore.put / .get — args carry the truncated
+    # page key and, for gets, the serving tier) and one per store-server
+    # (re)spawn (StoreServer._spawn — args carry pid/incarnation/
+    # transport/port).  Failed ops emit nothing: they degrade to counted
+    # cold misses (engine.prefix_store_misses_remote) by contract
+    "cluster.store.put",
+    "cluster.store.get",
+    "cluster.store.serve",
     # graph layer
     "graph.query",
     # rca pipeline stages

@@ -27,12 +27,20 @@ import struct
 import zlib
 from typing import BinaryIO, Iterator, List, Tuple
 
-_HEADER = struct.Struct(">II")      # (payload length, CRC32 of payload)
-HEADER_SIZE = _HEADER.size
+# THE frame header: (payload length, CRC32 of payload), big-endian.
+# Single source of truth for every CRC-framed byte stream in the tree —
+# the WAL journal, the prefix-store ``.page`` disk entries
+# (utils/pages.py), and the out-of-process wire protocol (cluster/
+# wire.py re-exports these same objects) — so a record written by one
+# layer is byte-for-byte a legal frame to every other.
+HEADER = struct.Struct(">II")
+HEADER_SIZE = HEADER.size
+_HEADER = HEADER                    # internal alias (pre-share spelling)
 
 # frames above this are assumed to be torn-tail garbage, not real records
 # (a length field read out of random bytes is uniform over 4 GiB; journal
-# payloads are compact JSON far below this)
+# payloads are compact JSON far below this).  Shared with the wire codec
+# as MAX_FRAME_SIZE: the disk and wire record-size guards cannot drift.
 MAX_RECORD_SIZE = 16 * 1024 * 1024
 
 

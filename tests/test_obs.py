@@ -399,6 +399,41 @@ class TestPrometheus:
         assert ('k8s_llm_rca_cluster_fleet_size'
                 '{tier="decode"} 2') in text
 
+    def test_store_fabric_families_two_way(self):
+        """Cache-fabric exposition (cluster/store.py): with a live store
+        handle, hits render as the labeled
+        cluster_store_hits_total{tier=} counter plus op/residency
+        gauges; without one — or with a DEAD store, whose stats()
+        degrades to {} by the fabric's cold-miss contract — the
+        families stay absent and the scrape never errors (two-way
+        coverage)."""
+
+        class _StubStore:
+            def stats(self):
+                return {"puts": 3.0, "gets": 5.0, "hits_l1": 2.0,
+                        "hits_l2": 1.0, "misses": 2.0, "rejected": 0.0,
+                        "n_host": 2, "n_disk": 1}
+
+        class _DeadStore:
+            def stats(self):
+                return {}
+
+        text = prometheus_text(Metrics())
+        assert "cluster_store_" not in text
+        text = prometheus_text(Metrics(), store=_StubStore())
+        assert ('k8s_llm_rca_cluster_store_hits_total'
+                '{tier="l1"} 2') in text
+        assert ('k8s_llm_rca_cluster_store_hits_total'
+                '{tier="l2"} 1') in text
+        assert ("# TYPE k8s_llm_rca_cluster_store_hits_total "
+                "counter") in text
+        assert "k8s_llm_rca_cluster_store_puts 3" in text
+        assert "k8s_llm_rca_cluster_store_misses 2" in text
+        assert "k8s_llm_rca_cluster_store_n_host 2" in text
+        assert "# TYPE k8s_llm_rca_cluster_store_n_disk gauge" in text
+        text = prometheus_text(Metrics(), store=_DeadStore())
+        assert "cluster_store_" not in text
+
 
 # ---------------------------------------------------------------------------
 # golden byte-identity: traced seeded chaos soak (acceptance bar)
@@ -826,6 +861,31 @@ class TestSiteCoverage:
             assert critical_path(tr_fleet, emit=True)
         assert {"cluster.proc.serve", "cluster.telemetry.ship",
                 "cluster.telemetry.drain"} <= tr_fleet.emitted_names()
+
+        # (14) cache-fabric sites: spawn ONE real store server (own
+        # interpreter, ~0.5 s), round-trip a page record through the
+        # RemoteStore client — the serve (spawn) event and the
+        # put/get success events all fire (cluster/store.py; failed
+        # ops emit nothing by the cold-miss contract)
+        import numpy as np
+
+        from k8s_llm_rca_tpu.cluster.store import RemoteStore, StoreServer
+
+        tr_store = Tracer(clock=VirtualClock())
+        tracers.append(tr_store)
+        with obs_trace.tracing(tr_store):
+            store_server = StoreServer(host_pages=4, transport="pipe")
+            try:
+                remote_store = RemoteStore(server=store_server)
+                rec = {"n_pages": 1,
+                       "k": np.zeros((1, 1, 2, 4), np.float32),
+                       "v": np.zeros((1, 1, 2, 4), np.float32)}
+                remote_store.put(b"\x01" * 20, rec)
+                assert remote_store.get(b"\x01" * 20) is not None
+            finally:
+                store_server.close()
+        assert {"cluster.store.serve", "cluster.store.put",
+                "cluster.store.get"} <= tr_store.emitted_names()
 
         missing = coverage_missing(*tracers)
         assert not missing, f"registered sites never emitted: {missing}"
