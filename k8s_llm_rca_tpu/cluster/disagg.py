@@ -62,6 +62,7 @@ from k8s_llm_rca_tpu.cluster.router import ClusterRouter
 from k8s_llm_rca_tpu.cluster.wire import WireError
 from k8s_llm_rca_tpu.faults import inject
 from k8s_llm_rca_tpu.obs import trace as obs_trace
+from k8s_llm_rca_tpu.runtime.rules import validate_layout
 from k8s_llm_rca_tpu.serve.backend import GenOptions
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 
@@ -132,6 +133,15 @@ class TierRouter(ClusterRouter):
                 f"adopt_run) while others are engine-backed — a KV frame "
                 f"one side produces, the other cannot adopt")
         self._kv_seam = all(seam)
+        # per-tier weight-layout pre-flight: engine replicas stamped with
+        # kv_layout/layout metadata (cluster/replica.build_replicas) must
+        # be handoff-compatible across tiers and sit on disjoint devices;
+        # scripted replicas (no metadata) skip both checks.
+        self._kv_ref: Optional[Tuple[int, Dict[str, Any]]] = None
+        members = prefill + decode
+        for r in members:
+            self._check_kv_member(r)
+            self._check_layout_member(r, members)
         super().__init__(prefill + decode,
                          max_inflight_per_replica=max_inflight_per_replica,
                          quarantine_after=quarantine_after)
@@ -224,10 +234,63 @@ class TierRouter(ClusterRouter):
 
     # ----------------------------------------------------- fleet membership
 
+    def _check_kv_member(self, replica: Replica) -> None:
+        """Cross-tier KV-record compatibility: the FIRST replica carrying
+        ``kv_layout`` metadata becomes the fleet reference; every later
+        one must match it on kv_dtype / kv_dim / n_layers (frame geometry
+        a page record cannot cross) and on cache kind.  ``page_size`` MAY
+        differ between tiers — the adopting paged engine re-chunks the
+        record deterministically (engine/paged.py ``adopt_run``,
+        ``engine.handoff_kv_relayout``).  Replicas without the metadata
+        (scripted echo/oracle tiers) skip."""
+        kv = getattr(replica, "kv_layout", None)
+        if kv is None:
+            return
+        if self._kv_ref is None:
+            self._kv_ref = (replica.replica_id, dict(kv))
+            return
+        ref_rid, ref = self._kv_ref
+        for field in ("kv_dtype", "kv_dim", "n_layers"):
+            if kv.get(field) != ref.get(field):
+                raise ValueError(
+                    f"TierRouter refuses replica {replica.replica_id}: its "
+                    f"KV layout {field}={kv.get(field)!r} does not match "
+                    f"replica {ref_rid}'s {field}={ref.get(field)!r} — a "
+                    f"handoff frame crossing this pair can neither be "
+                    f"adopted nor deterministically converted (page_size "
+                    f"may differ between tiers; dtype/width/depth may not)")
+        mode = "paged" if kv.get("page_size") is not None else "contiguous"
+        ref_mode = ("paged" if ref.get("page_size") is not None
+                    else "contiguous")
+        if mode != ref_mode:
+            raise ValueError(
+                f"TierRouter refuses replica {replica.replica_id}: its "
+                f"{mode} KV cache cannot hand off against replica "
+                f"{ref_rid}'s {ref_mode} one — both tiers must run the "
+                f"same cache kind (only page_size may differ)")
+
+    @staticmethod
+    def _check_layout_member(replica: Replica, others) -> None:
+        """Layout pre-flight for a tier member that carries a SpecLayout
+        and a real mesh: re-run ``runtime.rules.validate_layout`` with
+        every OTHER member's mesh as a peer, so per-tier submeshes that
+        overlap on a device are a named ValueError at construction."""
+        layout = getattr(replica, "layout", None)
+        mesh = getattr(replica, "mesh", None)
+        if layout is None or not hasattr(getattr(mesh, "devices", None),
+                                         "flat"):
+            return
+        peers = [m for m in (getattr(o, "mesh", None) for o in others
+                             if o is not replica)
+                 if hasattr(getattr(m, "devices", None), "flat")]
+        validate_layout(layout, mesh, peers=peers)
+
     def _check_tier_member(self, replica: Replica) -> None:
         """The __init__ member exclusions, applied to a late admission:
-        no cp/pp mesh axes, and the newcomer must sit on the SAME
-        handoff seam as the incumbent fleet."""
+        no cp/pp mesh axes, the newcomer must sit on the SAME handoff
+        seam as the incumbent fleet, its KV record geometry must be
+        adoptable, and its (layout, mesh) must pass pre-flight against
+        the incumbents' meshes."""
         axes = tuple(getattr(getattr(replica, "mesh", None),
                              "axis_names", ()) or ())
         bad = [a for a in axes if a in ("cp", "pp")]
@@ -244,6 +307,8 @@ class TierRouter(ClusterRouter):
                 f"TierRouter refuses replica {replica.replica_id}: it is "
                 f"{kind} while the fleet is {fleet} — every tier member "
                 f"must sit on the same handoff seam")
+        self._check_kv_member(replica)
+        self._check_layout_member(replica, list(self.replicas.values()))
 
     def add_replica(self, replica: Replica,
                     tier: Optional[str] = None) -> None:

@@ -202,6 +202,38 @@ def _build_worker_backend(spec: Dict[str, Any]):
         params = llama.init_params(cfg,
                                    jax.random.PRNGKey(spec.get("seed", 0)))
         tok = get_tokenizer(vocab_size=cfg.vocab_size)
+        # per-tier weight layout (spec "layout"/"mesh_shape", validated
+        # parent-side by build_proc_replicas): the worker builds a
+        # data×fsdp×tp mesh over its OWN virtual CPU devices (the spec's
+        # "devices" count, pinned by worker_env), rule-shards the params
+        # under the shipped SpecLayout, and hands the mesh to the engine
+        # for cache/pool placement — same params, same seed, different
+        # layout per tier; greedy outputs stay byte-identical (GSPMD
+        # committed-input propagation, tests/test_sharding_rules.py).
+        mesh_kw: Dict[str, Any] = {}
+        layout_d = spec.get("layout")
+        mesh_shape = spec.get("mesh_shape") or {}
+        if layout_d is not None or mesh_shape:
+            from k8s_llm_rca_tpu.config import MeshConfig
+            from k8s_llm_rca_tpu.runtime.mesh import build_mesh
+            from k8s_llm_rca_tpu.runtime.rules import (
+                FSDP_LAYOUT, SpecLayout, TP_LAYOUT, validate_layout,
+            )
+            from k8s_llm_rca_tpu.runtime.sharding import (
+                llama_param_specs, shard_pytree,
+            )
+
+            mcfg = MeshConfig(**{k: int(v) for k, v in mesh_shape.items()})
+            mesh = build_mesh(mcfg, devices=jax.devices()[:mcfg.n_devices])
+            layout = (SpecLayout.from_dict(layout_d)
+                      if layout_d is not None
+                      else (FSDP_LAYOUT if mcfg.fsdp > 1 else TP_LAYOUT))
+            validate_layout(layout, mesh)
+            params = shard_pytree(
+                params, llama_param_specs(cfg, layout=layout), mesh)
+            mesh_kw["tp_mesh"] = mesh
+            if mcfg.fsdp > 1:
+                mesh_kw["fsdp_mesh"] = mesh
         # cache-fabric attachment (docs/cluster.md "Cache fabric"): a
         # ``store_addr`` [host, port] in the spec dials the shared
         # cross-host StoreServer and plugs it in as the engine's prefix
@@ -218,7 +250,8 @@ def _build_worker_backend(spec: Dict[str, Any]):
             store = RemoteStore(addr=(str(host), int(port)))
         backend = EngineBackend(make_engine(cfg, ecfg, params, tok,
                                             use_kernel=False,
-                                            prefix_store=store))
+                                            prefix_store=store,
+                                            **mesh_kw))
         return backend, (lambda: int(backend.engine.heartbeat))
     raise ValueError(f"unknown proc worker kind {kind!r}: expected one "
                      f"of {WORKER_KINDS}")
@@ -1464,9 +1497,19 @@ def build_proc_replicas(n_replicas: int, kind: str = "oracle",
     ``"pipe"`` keeps the PR 12 stdio protocol byte-identical.
 
     Loud exclusions (repo convention): proc replicas compose with the
-    router/watchdog/supervisor stack, NOT with multi-device sharding —
-    a worker owns its whole (single-device CPU) engine, so CP/PP/mesh
-    arguments are rejected here instead of failing deep in a worker.
+    router/watchdog/supervisor stack, NOT with cross-worker sharding —
+    a worker owns its whole engine, so CP/PP/mesh arguments are
+    rejected here instead of failing deep in a worker.
+
+    ``layout`` (a ``runtime.rules.SpecLayout`` or its ``to_dict`` form)
+    plus ``mesh_shape`` (axis-size dict over data/fsdp/model) give each
+    ENGINE worker a per-tier weight layout over its own virtual CPU
+    devices: the worker builds the mesh, rule-shards the shared-seed
+    params under the layout, and places its KV pool accordingly — the
+    proc-fleet face of the per-tier layouts ``build_replicas`` offers
+    in-process.  Validated HERE (typo'd axes, non-engine kinds,
+    device-count mismatches, fsdp layouts without an fsdp axis) so a
+    bad spec fails in the parent, not as a worker spawn corpse.
     """
     if n_replicas < 1:
         raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -1478,6 +1521,40 @@ def build_proc_replicas(n_replicas: int, kind: str = "oracle",
                 f"owns its whole single-process engine (CP/PP/submesh "
                 f"sharding is the in-process build_replicas path); spawn "
                 f"more replicas instead")
+    layout = spec.get("layout")
+    mesh_shape = spec.get("mesh_shape")
+    if layout is not None or mesh_shape is not None:
+        from k8s_llm_rca_tpu.runtime.rules import SpecLayout
+
+        if kind != "engine":
+            raise ValueError(
+                f"layout/mesh_shape compose with kind='engine' proc "
+                f"workers only (kind={kind!r} carries no params to lay "
+                f"out)")
+        if isinstance(layout, SpecLayout):
+            layout = spec["layout"] = layout.to_dict()
+        if layout is not None:
+            SpecLayout.from_dict(layout)      # typo'd axes die parent-side
+        shape = dict(mesh_shape or {})
+        bad = sorted(set(shape) - {"data", "fsdp", "model"})
+        if bad:
+            raise ValueError(
+                f"proc worker mesh_shape supports data/fsdp/model axes "
+                f"only, got {bad}: CP/PP/EP do not compose with proc "
+                f"replicas")
+        n_dev = 1
+        for v in shape.values():
+            n_dev *= int(v)
+        if int(spec.get("devices", n_dev)) != n_dev:
+            raise ValueError(
+                f"spec devices={spec.get('devices')} does not match the "
+                f"mesh_shape device product {n_dev}")
+        spec["devices"] = n_dev
+        if (layout or {}).get("fsdp") and shape.get("fsdp", 1) <= 1:
+            raise ValueError(
+                f"layout maps fsdp to axis {layout['fsdp']!r} but "
+                f"mesh_shape carries no fsdp axis > 1: the layout "
+                f"requests sharding that cannot happen")
     return [ProcReplica(rid, kind=kind, **spec)
             for rid in range(n_replicas)]
 

@@ -62,11 +62,19 @@ class Replica:
     """
 
     def __init__(self, replica_id: int, backend: Any, mesh=None,
-                 rebuild=None):
+                 rebuild=None, layout=None, kv_layout=None):
         self.replica_id = replica_id
         self.backend = backend
         self.mesh = mesh
         self.rebuild = rebuild
+        # weight-layout metadata for tiered serving (cluster/disagg.py):
+        # ``layout`` is the runtime.rules.SpecLayout the params were
+        # sharded under; ``kv_layout`` describes the KV-record geometry
+        # a handoff peer must be able to adopt ({"page_size","kv_dtype",
+        # "kv_dim","n_layers"}).  Scripted replicas (echo/oracle) leave
+        # both None and skip the tier compatibility checks.
+        self.layout = layout
+        self.kv_layout = kv_layout
         self.alive = True
         self.wedged = False
         self.draining = False
@@ -116,17 +124,28 @@ EngineReplica = Replica
 
 def build_replicas(model_cfg, engine_cfg, n_replicas: int,
                    devices: Optional[Sequence[Any]] = None,
-                   data: int = 1, seed: int = 0,
-                   meshes=None, prefix_store=None,
+                   data: int = 1, fsdp: int = 1, seed: int = 0,
+                   meshes=None, prefix_store=None, layout=None,
                    **engine_kw) -> List[Replica]:
     """N engine replicas on disjoint submeshes, one shared param init.
 
     ``meshes``: pre-carved submeshes (else ``carve_replica_meshes`` runs
-    with ``devices``/``data``).  Every mesh passes
+    with ``devices``/``data``/``fsdp``).  Every mesh passes
     ``validate_replica_mesh`` — CP/PP/EP × replica compositions and
     submeshes the TINY head layout cannot shard are rejected loudly
     before any device work.  ``engine_kw`` forwards to ``make_engine``
     (e.g. ``use_kernel=False`` on the CPU test mesh).
+
+    ``layout``: a ``runtime.rules.SpecLayout`` naming which mesh axes
+    the logical data/fsdp/tp axes land on — the per-tier weight-layout
+    hook (docs/cluster.md): a prefill tier can build TP-heavy replicas
+    and a decode tier KV-wide ones from the SAME host params.  Defaults
+    to ``FSDP_LAYOUT`` when the submeshes carry an fsdp axis > 1, else
+    ``TP_LAYOUT``.  Every (layout, mesh) pair passes
+    ``runtime.rules.validate_layout`` pre-flight — undefined axes and
+    non-default mappings onto size-1 axes are named ValueErrors before
+    any weight moves, and the supervisor ``rebuild`` recipe re-runs the
+    same check so a restarted incarnation cannot silently change layout.
 
     ``prefix_store``: one SHARED ``engine.prefix.PrefixStore`` handed to
     every replica's engine (docs/cluster.md "warm-start"): pages any
@@ -142,17 +161,23 @@ def build_replicas(model_cfg, engine_cfg, n_replicas: int,
     from k8s_llm_rca_tpu.engine import make_engine
     from k8s_llm_rca_tpu.models import llama
     from k8s_llm_rca_tpu.runtime.sharding import (
-        llama_param_specs, shard_pytree,
+        FSDP_LAYOUT, TP_LAYOUT, llama_param_specs, shard_pytree,
+        validate_layout,
     )
     from k8s_llm_rca_tpu.serve.backend import EngineBackend
 
     if meshes is None:
         meshes = carve_replica_meshes(n_replicas, devices=devices,
-                                      data=data)
+                                      data=data, fsdp=fsdp)
     if len(meshes) != n_replicas:
         raise ValueError(f"{len(meshes)} meshes for {n_replicas} replicas")
+    if layout is None:
+        has_fsdp = fsdp > 1 or any(
+            m is not None and m.shape.get("fsdp", 1) > 1 for m in meshes)
+        layout = FSDP_LAYOUT if has_fsdp else TP_LAYOUT
     for mesh in meshes:
         validate_replica_mesh(mesh, model_cfg, engine_cfg)
+        validate_layout(layout, mesh)
 
     if prefix_store is not None:
         engine_kw = dict(engine_kw, prefix_store=prefix_store)
@@ -162,7 +187,13 @@ def build_replicas(model_cfg, engine_cfg, n_replicas: int,
 
         tok = get_tokenizer(vocab_size=model_cfg.vocab_size)
     params = llama.init_params(model_cfg, jax.random.PRNGKey(seed))
-    specs = llama_param_specs(model_cfg)
+    specs = llama_param_specs(model_cfg, layout=layout)
+    kv_layout = {
+        "page_size": engine_cfg.page_size if engine_cfg.paged else None,
+        "kv_dtype": engine_cfg.kv_cache_dtype,
+        "kv_dim": model_cfg.kv_dim,
+        "n_layers": model_cfg.n_layers,
+    }
 
     replicas: List[Replica] = []
     for rid, mesh in enumerate(meshes):
@@ -175,14 +206,18 @@ def build_replicas(model_cfg, engine_cfg, n_replicas: int,
             # restart-and-rejoin recipe (cluster/health.py): re-shard the
             # SAME host params onto the replica's ORIGINAL submesh — the
             # identical-replica invariant, so a restarted incarnation
-            # generates byte-identically to the first
+            # generates byte-identically to the first.  The layout
+            # pre-flight re-runs too: a rebuild can never adopt a layout
+            # the original mesh would have refused.
+            validate_layout(layout, mesh)
             eng = make_engine(model_cfg, engine_cfg,
                               shard_pytree(params, specs, mesh), tok, **kw)
             eng.obs_replica = rid
             return EngineBackend(eng)
 
         replicas.append(Replica(rid, EngineBackend(engine), mesh=mesh,
-                                rebuild=_rebuild))
+                                rebuild=_rebuild, layout=layout,
+                                kv_layout=kv_layout))
     log.info("built %d engine replicas: %s devices each",
              len(replicas), meshes[0].devices.size if replicas else 0)
     return replicas

@@ -32,13 +32,14 @@ from k8s_llm_rca_tpu.runtime.mesh import build_mesh
 
 def carve_replica_meshes(n_replicas: int,
                          devices: Optional[Sequence[jax.Device]] = None,
-                         data: int = 1) -> List[Mesh]:
+                         data: int = 1, fsdp: int = 1) -> List[Mesh]:
     """Split the device list into ``n_replicas`` contiguous groups and
-    build one dp×tp mesh per group.
+    build one dp×fsdp×tp mesh per group.
 
     ``data``: DP width inside each replica (default 1 — replicas ARE the
-    data parallelism); the model axis takes the rest of the group.
-    Raises loudly when the device count does not divide.
+    data parallelism); ``fsdp``: parameter-sharding width (all-gather on
+    use, runtime/rules.py FSDP_LAYOUT); the model axis takes the rest of
+    the group.  Raises loudly when the device count does not divide.
     """
     if n_replicas < 1:
         raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -49,11 +50,11 @@ def carve_replica_meshes(n_replicas: int,
             f"replica submeshes; pick a replica count dividing the "
             f"device count")
     per = len(devices) // n_replicas
-    if per % data:
+    if per % (data * fsdp):
         raise ValueError(
             f"replica submesh of {per} devices does not carry a data "
-            f"axis of {data}")
-    cfg = MeshConfig(data=data, model=per // data)
+            f"axis of {data} times an fsdp axis of {fsdp}")
+    cfg = MeshConfig(data=data, fsdp=fsdp, model=per // (data * fsdp))
     meshes = [build_mesh(cfg, devices=devices[i * per:(i + 1) * per])
               for i in range(n_replicas)]
     validate_disjoint_submeshes(meshes)

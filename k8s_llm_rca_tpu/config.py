@@ -149,7 +149,10 @@ E5_LARGE = EncoderConfig(
 class MeshConfig:
     """Logical device-mesh shape.  Axis names are load-bearing throughout:
 
-    - ``data``   — DP: batch sharding (and FSDP-style weight sharding later)
+    - ``data``   — DP: batch sharding
+    - ``fsdp``   — FSDP: parameter sharding with all-gather-on-use (weights
+                   split along their non-TP dim; runtime/rules.py SpecLayout
+                   decides which params land on it)
     - ``model``  — TP: attention heads / MLP hidden dim over ICI
     - ``expert`` — EP: MoE experts (all-to-all token dispatch)
     - ``seq``    — SP/CP: sequence sharding (ring attention / Ulysses)
@@ -157,6 +160,7 @@ class MeshConfig:
     """
 
     data: int = 1
+    fsdp: int = 1
     model: int = 1
     expert: int = 1
     seq: int = 1
@@ -164,11 +168,12 @@ class MeshConfig:
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return ("data", "model", "expert", "seq", "stage")
+        return ("data", "fsdp", "model", "expert", "seq", "stage")
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.data, self.model, self.expert, self.seq, self.stage)
+        return (self.data, self.fsdp, self.model, self.expert, self.seq,
+                self.stage)
 
     @property
     def n_devices(self) -> int:

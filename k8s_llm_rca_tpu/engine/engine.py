@@ -154,6 +154,56 @@ def validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh=None,
             f"{tp_mesh.shape['data']}")
 
 
+def validate_fsdp_mesh(fsdp_mesh, model_cfg, engine_cfg, tp_mesh=None,
+                       cp_mesh=None, ep_mesh=None, pp_mesh=None,
+                       sp: bool = False) -> None:
+    """FSDP serving preconditions (shared by both engines): parameters
+    shard along the "fsdp" axis (runtime/rules.py FSDP_LAYOUT — the
+    non-TP matmul dim: hidden for the blocks, vocab for the embeddings)
+    and GSPMD all-gathers each weight on use, so prefill and decode run
+    unchanged and greedy parity is byte-identical.
+
+    Composes with TP on ONE mesh carrying both "fsdp" and "model"
+    (fsdp×tp — the 8-virtual-device parity row).  PP/CP/EP and SP are
+    refused loudly until their greedy-parity matrix lands: each of those
+    modes hand-places weights or activations (stage bodies, ring
+    attention, all-to-all dispatch) and would silently gather the full
+    weight per device without a proven composition rule.  KV caches never
+    shard on fsdp (kv_cache_specs) — only the weights do."""
+    if fsdp_mesh is None:
+        return
+    for axis in ("data", "fsdp", "model"):
+        if axis not in fsdp_mesh.shape:
+            raise ValueError(f"fsdp_mesh needs a '{axis}' axis, has "
+                             f"{dict(fsdp_mesh.shape)}")
+    if tp_mesh is not None and tp_mesh is not fsdp_mesh:
+        raise ValueError(
+            "fsdp_mesh and tp_mesh must be the SAME composed mesh (one "
+            "Mesh carrying 'fsdp' and 'model'); two distinct meshes "
+            "cannot both lay out the weights")
+    for other, what in ((cp_mesh, "CP"), (ep_mesh, "EP"), (pp_mesh, "PP")):
+        if other is not None:
+            raise ValueError(
+                f"fsdp×{what} is unsupported until its greedy-parity "
+                f"matrix lands (tests/test_sharding_rules.py): compose "
+                f"fsdp with TP only")
+    if sp:
+        raise ValueError(
+            "fsdp×SP is unsupported until its greedy-parity matrix lands: "
+            "compose fsdp with TP only")
+    n_f = fsdp_mesh.shape["fsdp"]
+    for dim, what in ((model_cfg.hidden_size, "hidden_size"),
+                      (model_cfg.vocab_size, "vocab_size")):
+        if dim % n_f:
+            raise ValueError(
+                f"{what}={dim} not divisible by fsdp axis {n_f} "
+                f"(fsdp shards the hidden/vocab dim of every weight)")
+    if engine_cfg.max_batch % fsdp_mesh.shape["data"]:
+        raise ValueError(
+            f"max_batch={engine_cfg.max_batch} not divisible by data axis "
+            f"{fsdp_mesh.shape['data']}")
+
+
 def validate_replica_mesh(mesh, model_cfg, engine_cfg) -> None:
     """Cluster-replica preconditions (cluster/submesh.py): a replica
     submesh is a plain dp×tp carve of the global device list.  The
@@ -173,6 +223,9 @@ def validate_replica_mesh(mesh, model_cfg, engine_cfg) -> None:
                 f"submeshes carve dp×tp only (cluster/submesh.py) — run "
                 f"{what} inside ONE engine on the full mesh instead")
     validate_tp_mesh(mesh, model_cfg, engine_cfg)
+    if mesh.shape.get("fsdp", 1) > 1:
+        # dp×fsdp×tp carve (cluster/submesh.py fsdp=): same-mesh compose
+        validate_fsdp_mesh(mesh, model_cfg, engine_cfg, tp_mesh=mesh)
 
 
 def validate_disjoint_submeshes(meshes) -> None:
@@ -1669,6 +1722,7 @@ class InferenceEngine(EngineBase):
         cp_mode: str = "ring",
         ep_mesh=None,
         tp_mesh=None,
+        fsdp_mesh=None,
         pp_mesh=None,
         pp_microbatches: Optional[int] = None,
         pp_stage_axis: str = "stage",
@@ -1702,7 +1756,14 @@ class InferenceEngine(EngineBase):
         — the residual stream between matmul regions seq-shards over
         "model" (llama._sp_constrain), so norms/elementwise stop
         replicating across the TP group.  Requires ``tp_mesh``; the CP
-        modes already seq-shard activations their own way (exclusive)."""
+        modes already seq-shard activations their own way (exclusive).
+
+        ``fsdp_mesh``: optional Mesh with an "fsdp" axis — parameters
+        arrive sharded along it (runtime/rules.py FSDP_LAYOUT; the non-TP
+        matmul dim splits) and GSPMD all-gathers each weight on use in
+        both prefill and decode.  Composes with TP on the SAME mesh
+        (fsdp×tp); PP/CP/EP/sp are refused loudly (validate_fsdp_mesh).
+        The KV cache never shards on fsdp."""
         if cp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_mode {cp_mode!r}")
         if sp and (tp_mesh is None or cp_mesh is not None
@@ -1763,6 +1824,9 @@ class InferenceEngine(EngineBase):
                          cp_seq_axis)
         validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh,
                          cp_seq_axis)
+        validate_fsdp_mesh(fsdp_mesh, model_cfg, engine_cfg, tp_mesh=tp_mesh,
+                           cp_mesh=cp_mesh, ep_mesh=ep_mesh, pp_mesh=pp_mesh,
+                           sp=sp)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
                                       cp_mesh, ep_mesh, tp_mesh,
                                       pp_microbatches, pp_stage_axis,
@@ -1828,10 +1892,14 @@ class InferenceEngine(EngineBase):
                 self.cache,
                 llama.KVCache(kv_spec, kv_spec, scale_spec, scale_spec),
                 tp_mesh)
-        elif tp_mesh is not None:
+        elif tp_mesh is not None or fsdp_mesh is not None:
             # place the cache sharded from the start (merged kv axis over
             # "model", slots over "data") so each device holds 1/P of the
-            # KV bytes — the real memory win of serving TP
+            # KV bytes — the real memory win of serving TP.  fsdp never
+            # shards KV (rules.kv_cache_specs): an fsdp-only mesh places
+            # the cache on the same device set as the weights with the
+            # "model" axis degenerate, so GSPMD keeps cache and gathered
+            # weights co-resident
             from jax.sharding import PartitionSpec as _P
 
             from k8s_llm_rca_tpu.runtime.sharding import (
@@ -1843,7 +1911,7 @@ class InferenceEngine(EngineBase):
                 self.cache,
                 llama.KVCache(kv_spec, kv_spec,
                               _P(None, "data", None), _P(None, "data", None)),
-                tp_mesh)
+                tp_mesh if tp_mesh is not None else fsdp_mesh)
         elif cp_mesh is not None:
             # context-parallel serving: the cache's SEQUENCE axis shards
             # over the CP mesh, so a context too large for one chip's HBM
@@ -1941,8 +2009,13 @@ class InferenceEngine(EngineBase):
 
             self._prefill = jax.jit(_prefill_cp, static_argnums=0)
         else:
-            use_flash, flash_mesh = flash_prefill_plan(params, tp_mesh,
-                                                       model_cfg, ep_mesh)
+            # fsdp-sharded weights exclude the per-shard flash kernel (the
+            # head-sharded shard_map would consume a weight shard as if it
+            # were the full tensor) — the XLA path with GSPMD all-gathers
+            # serves fsdp/fsdp×tp prefill
+            use_flash, flash_mesh = flash_prefill_plan(
+                params, None if fsdp_mesh is not None else tp_mesh,
+                model_cfg, ep_mesh)
             sp_mesh = tp_mesh if sp else None
             self._prefill = jax.jit(
                 functools.partial(llama.prefill, use_flash=use_flash,

@@ -59,9 +59,9 @@ from k8s_llm_rca_tpu.ops.rope import rope_frequencies
 from k8s_llm_rca_tpu.runtime import profiling
 from k8s_llm_rca_tpu.utils.logging import METRICS, get_logger
 from k8s_llm_rca_tpu.utils.pages import (
-    gather_pages, pool_compatible, record_fields, record_nbytes,
-    records_compatible, restore_pages, split_pages, stack_pages,
-    suffix_bucket,
+    convert_page_record, gather_pages, pool_compatible, record_fields,
+    record_nbytes, records_compatible, restore_pages, split_pages,
+    stack_pages, suffix_bucket,
 )
 from k8s_llm_rca_tpu.utils.tokenizer import Tokenizer
 
@@ -886,6 +886,7 @@ class PagedInferenceEngine(EngineBase):
                  use_kernel: Optional[bool] = None,
                  cp_mesh=None, cp_seq_axis: str = "seq",
                  cp_mode: str = "ring", ep_mesh=None, tp_mesh=None,
+                 fsdp_mesh=None,
                  pp_mesh=None, pp_microbatches: Optional[int] = None,
                  pp_stage_axis: str = "stage", sp: bool = False,
                  draft_model=None, prefix_store: Optional[PrefixStore] = None):
@@ -912,13 +913,16 @@ class PagedInferenceEngine(EngineBase):
                              "unsupported on the PP paths (the pipelined "
                              "prefill/decode do not thread sp_mesh)")
         from k8s_llm_rca_tpu.engine.engine import (
-            params_multi_device, validate_ep_mesh, validate_pp_mesh,
-            validate_tp_mesh,
+            params_multi_device, validate_ep_mesh, validate_fsdp_mesh,
+            validate_pp_mesh, validate_tp_mesh,
         )
         validate_ep_mesh(ep_mesh, model_cfg, engine_cfg, cp_mesh,
                          cp_seq_axis)
         validate_tp_mesh(tp_mesh, model_cfg, engine_cfg, cp_mesh,
                          cp_seq_axis)
+        validate_fsdp_mesh(fsdp_mesh, model_cfg, engine_cfg, tp_mesh=tp_mesh,
+                           cp_mesh=cp_mesh, ep_mesh=ep_mesh, pp_mesh=pp_mesh,
+                           sp=sp)
         self._pp_m = validate_pp_mesh(pp_mesh, model_cfg, engine_cfg,
                                       cp_mesh, ep_mesh, tp_mesh,
                                       pp_microbatches, pp_stage_axis,
@@ -945,9 +949,10 @@ class PagedInferenceEngine(EngineBase):
         # nibble packing does not commute with the head shard).
         self._kernel_mesh = None
         if (tp_mesh is not None or cp_mesh is not None
-                or params_multi_device(params)):
+                or fsdp_mesh is not None or params_multi_device(params)):
             n_tp = tp_mesh.shape["model"] if tp_mesh is not None else 0
             sharded_ok = (tp_mesh is not None and cp_mesh is None
+                          and fsdp_mesh is None
                           and n_tp > 0
                           and model_cfg.n_heads % n_tp == 0
                           and model_cfg.n_kv_heads % n_tp == 0
@@ -957,7 +962,9 @@ class PagedInferenceEngine(EngineBase):
                     "use_kernel=True under sharding requires a tp_mesh "
                     "with n_heads/n_kv_heads divisible by its 'model' "
                     "axis, no cp_mesh (the CP pool's page axis is "
-                    "seq-sharded), and kv_cache_dtype != 'int4' (nibble "
+                    "seq-sharded), no fsdp_mesh (the head-sharded "
+                    "shard_map would consume a weight shard as the full "
+                    "tensor), and kv_cache_dtype != 'int4' (nibble "
                     "packing does not commute with the head shard); pass "
                     "use_kernel=None/False to serve this config on the "
                     "XLA paged-attention path")
@@ -1205,20 +1212,22 @@ class PagedInferenceEngine(EngineBase):
                 PagePool(kv_spec, kv_spec, kv_scale_stage_specs(pp_stage_axis),
                          kv_scale_stage_specs(pp_stage_axis)),
                 pp_mesh)
-        elif tp_mesh is not None:
+        elif tp_mesh is not None or fsdp_mesh is not None:
             # pool pages sharded on the merged kv axis over "model": each
             # device stores 1/P of every page's bytes (the paged analog of
-            # kv_cache_specs); tiny per-token scale pools replicate
-            from jax.sharding import PartitionSpec as _P
+            # kv_cache_specs); tiny per-token scale pools replicate.  fsdp
+            # never shards the pool (rules.paged_pool_specs) — an
+            # fsdp-only mesh places it on the weights' device set with the
+            # "model" axis degenerate
+            from k8s_llm_rca_tpu.runtime.sharding import (
+                paged_pool_specs, shard_pytree,
+            )
 
-            from k8s_llm_rca_tpu.runtime.sharding import shard_pytree
-
-            pool_spec = _P(None, None, None, "model")
-            scale_spec = _P(None, None, None)
+            pool_spec, scale_spec = paged_pool_specs()
             self.pool = shard_pytree(
                 self.pool,
                 PagePool(pool_spec, pool_spec, scale_spec, scale_spec),
-                tp_mesh)
+                tp_mesh if tp_mesh is not None else fsdp_mesh)
         elif pp_mesh is not None:
             # PP serving: the pool's LAYER axis shards over "stage" —
             # each device holds only its stage's layers' pages (the cache
@@ -1404,8 +1413,12 @@ class PagedInferenceEngine(EngineBase):
             self._prefill = jax.jit(_prefill_cp, static_argnums=0,
                                     donate_argnums=donate)
         else:
-            use_flash, flash_mesh = flash_prefill_plan(params, tp_mesh,
-                                                       model_cfg, ep_mesh)
+            # fsdp-sharded weights exclude the per-shard flash kernel (the
+            # head-sharded shard_map would consume a weight shard as the
+            # full tensor); GSPMD all-gathers serve fsdp/fsdp×tp prefill
+            use_flash, flash_mesh = flash_prefill_plan(
+                params, None if fsdp_mesh is not None else tp_mesh,
+                model_cfg, ep_mesh)
             self._prefill = jax.jit(
                 functools.partial(paged_prefill, use_flash=use_flash,
                                   ep_mesh=ep_mesh, flash_mesh=flash_mesh,
@@ -2987,27 +3000,75 @@ class PagedInferenceEngine(EngineBase):
                   grammar=None) -> int:
         """Paged ADOPT: re-admit the entry, then stage the transferred
         KV record as a local spill so ``_admit_spilled`` resumes it by
-        h2d restore at the exact preemption state.  EVERY validation
-        runs before any allocator/slot state moves; a record that fails
-        (wrong pool layout, length mismatch) is dropped whole and the
-        run re-prefills — same tokens, never a half-adopted sequence."""
+        h2d restore at the exact preemption state.  EVERY validation —
+        and any cross-layout conversion — runs BEFORE the entry is
+        admitted, so a refusal raised here leaves no engine state for
+        the router's retry to duplicate.  Three outcomes per record:
+
+        - geometry matches this pool → staged verbatim
+          (``engine.handoff_kv_adopted``);
+        - page_size differs but dtype/kv_dim/layer-count/field-set
+          match → deterministically re-chunked onto this pool's page
+          size (``utils.pages.convert_page_record``,
+          ``engine.handoff_kv_relayout`` counted alongside the adopt);
+        - torn frame (shared pages on the wire, length mismatch, page
+          overflow after conversion) → dropped whole and the run
+          re-prefills, counted ``engine.handoff_kv_rejected``; while a
+          dtype/kv_dim/field-set mismatch is a loud ValueError — that
+          is a MISCONFIGURED tier pair (TierRouter refuses to build
+          one), not a transient the retry loop could ever fix."""
+        relayout = False
+        if kv is not None:
+            resume_len = (len(entry["prompt_ids"])
+                          + len(entry["generated"]))
+            n = int(kv.get("n_pages", 0))
+            frame_ok = (int(kv.get("n_shared", 1)) == 0
+                        and not kv.get("shared_pages")
+                        and n >= 1
+                        and int(kv.get("length", -1)) + 1 == resume_len)
+            if not frame_ok:
+                self._count("engine.handoff_kv_rejected")
+                kv = None
+            else:
+                want_fields = (("k", "v", "k_scale", "v_scale")
+                               if self.pool.quantized else ("k", "v"))
+                karr = np.asarray(kv["k"])
+                ref = self.pool.k
+                if (record_fields(kv) != want_fields
+                        or karr.ndim != 4
+                        or karr.shape[0] != ref.shape[0]
+                        or karr.shape[3] != ref.shape[3]
+                        or karr.dtype != ref.dtype):
+                    raise ValueError(
+                        f"adopt_run: transfer record geometry "
+                        f"(fields={record_fields(kv)}, "
+                        f"shape={karr.shape}, dtype={karr.dtype}) is "
+                        f"incompatible with this pool "
+                        f"(fields={want_fields}, layers={ref.shape[0]}, "
+                        f"kv_dim={ref.shape[3]}, dtype={ref.dtype}): "
+                        f"only page_size may differ between tiers — "
+                        f"this is a misconfigured tier pair, not a "
+                        f"retryable frame fault")
+                if karr.shape[2] != ref.shape[2]:
+                    converted = convert_page_record(
+                        kv, int(kv["length"]), int(ref.shape[2]))
+                    converted.update(
+                        n_shared=0, shared_pages=[],
+                        length=int(kv["length"]),
+                        cur_token=int(kv["cur_token"]))
+                    kv, relayout = converted, True
+                    n = int(kv["n_pages"])
+                if n > self.pages_per_seq or not pool_compatible(
+                        self.pool, kv):
+                    self._count("engine.handoff_kv_rejected")
+                    kv = None
         sid = super().adopt_run(entry, kv=None, grammar=grammar)
         if kv is None:
             return sid
-        resume_len = (len(entry["prompt_ids"])
-                      + len(entry["generated"]))
-        n = int(kv.get("n_pages", 0))
-        ok = (int(kv.get("n_shared", 1)) == 0
-              and not kv.get("shared_pages")
-              and n >= 1
-              and int(kv.get("length", -1)) + 1 == resume_len
-              and n <= self.pages_per_seq
-              and pool_compatible(self.pool, kv))
-        if not ok:
-            self._count("engine.handoff_kv_rejected")
-            return sid
         self._spilled[sid] = kv
-        self._spilled_pages_total += n
+        self._spilled_pages_total += int(kv["n_pages"])
+        if relayout:
+            self._count("engine.handoff_kv_relayout")
         self._count("engine.handoff_kv_adopted")
         return sid
 

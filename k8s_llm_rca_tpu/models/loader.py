@@ -284,11 +284,36 @@ def llama_params_to_hf(cfg: ModelConfig, params: Params
     return {k: np.ascontiguousarray(v) for k, v in out.items()}
 
 
-def load_llama(cfg: ModelConfig, path: str) -> Params:
-    """Load a Llama/Mixtral-family checkpoint file or dir."""
-    return llama_params_from_hf(cfg, load_checkpoint_tensors(path))
+def load_llama(cfg: ModelConfig, path: str, mesh=None,
+               layout=None) -> Params:
+    """Load a Llama/Mixtral-family checkpoint file or dir.
+
+    With ``mesh`` the loaded pytree is placed through the partition-rule
+    tables (``runtime.rules.llama_rules`` under ``layout``,
+    ``runtime.sharding.shard_with_rules``): a checkpoint param no rule
+    matches is a loud ValueError NAMING the param before any weight
+    moves to a device — ingestion and serving read the same table, so
+    they cannot drift."""
+    params = llama_params_from_hf(cfg, load_checkpoint_tensors(path))
+    if mesh is None:
+        return params
+    from k8s_llm_rca_tpu.runtime.sharding import llama_rules, shard_with_rules
+
+    return shard_with_rules(llama_rules(cfg, layout), params, mesh,
+                            table="llama")
 
 
-def load_encoder(cfg: EncoderConfig, path: str) -> Params:
-    """Load a BERT/e5-family checkpoint file or dir."""
-    return encoder_params_from_hf(cfg, load_checkpoint_tensors(path))
+def load_encoder(cfg: EncoderConfig, path: str, mesh=None,
+                 layout=None) -> Params:
+    """Load a BERT/e5-family checkpoint file or dir; with ``mesh`` the
+    pytree is placed through ``runtime.rules.encoder_rules`` (same
+    unseen-param-is-a-ValueError contract as ``load_llama``)."""
+    params = encoder_params_from_hf(cfg, load_checkpoint_tensors(path))
+    if mesh is None:
+        return params
+    from k8s_llm_rca_tpu.runtime.sharding import (
+        encoder_rules, shard_with_rules,
+    )
+
+    return shard_with_rules(encoder_rules(cfg, layout), params, mesh,
+                            table="encoder")

@@ -7,10 +7,11 @@ ICI within a slice, DCN across hosts.  Everything downstream (TP matmul
 partials, ring-attention ppermute, MoE all-to-all, PP stage transfer) rides the
 mesh built here; multi-host pods go through ``jax.distributed.initialize``.
 
-Axis convention (see config.MeshConfig): ``data`` (DP), ``model`` (TP),
-``expert`` (EP), ``seq`` (SP/CP), ``stage`` (PP).  Axes of size 1 are kept in
-the mesh so sharding specs are uniform across topologies: a spec written for a
-v5e-16 runs unchanged on a single chip.
+Axis convention (see config.MeshConfig): ``data`` (DP), ``fsdp`` (parameter
+sharding with all-gather-on-use), ``model`` (TP), ``expert`` (EP), ``seq``
+(SP/CP), ``stage`` (PP).  Axes of size 1 are kept in the mesh so sharding
+specs are uniform across topologies: a spec written for a v5e-16 runs
+unchanged on a single chip.
 """
 
 from __future__ import annotations
@@ -44,7 +45,7 @@ def initialize_distributed(
 
 
 def build_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build the 5-axis logical mesh over the given (default: all) devices.
+    """Build the 6-axis logical mesh over the given (default: all) devices.
 
     Device order follows ``jax.devices()``, which JAX already orders so that
     adjacent devices are ICI neighbors; the fastest-varying axes here are
@@ -61,10 +62,10 @@ def build_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) 
 
 
 def local_mesh(model: int = 1, data: int = 1, expert: int = 1, seq: int = 1,
-               stage: int = 1) -> Mesh:
+               stage: int = 1, fsdp: int = 1) -> Mesh:
     """Convenience: build a mesh from axis sizes over local devices."""
-    return build_mesh(MeshConfig(data=data, model=model, expert=expert,
-                                 seq=seq, stage=stage))
+    return build_mesh(MeshConfig(data=data, fsdp=fsdp, model=model,
+                                 expert=expert, seq=seq, stage=stage))
 
 
 def single_device_mesh() -> Mesh:

@@ -5,118 +5,67 @@ boundaries with ``NamedSharding``/``with_sharding_constraint`` and let XLA
 insert the collectives (all-gather on column-parallel inputs, psum on
 row-parallel outputs) — the idiomatic TPU replacement for hand-written NCCL.
 
-Layout (per transformer layer):
+The specs themselves are no longer hand-rolled dicts: they are derived by
+matching the ordered regex rule tables in ``runtime/rules.py`` against a
+shape-only template of each model's param pytree (first match wins,
+scalars replicate, no match is a loud ValueError naming the param).  The
+``layout`` argument (a ``rules.SpecLayout``) picks which mesh axes the
+logical data/fsdp/tp/ep axes land on; the default reproduces the
+historical layout exactly:
+
 - wq/wk/wv  [H, heads*d]  -> P(None, "model")   (column parallel: heads sharded)
 - wo        [heads*d, H]  -> P("model", None)   (row parallel: psum output)
 - w_gate/w_up [H, I]      -> P(None, "model")
 - w_down    [I, H]        -> P("model", None)
 - embedding [V, H]        -> P(None, "model")   (hidden sharded; lm_head tied)
 - MoE experts get a leading "expert" axis on the stacked expert weights.
-Batch dims of activations shard on "data"; sequence on "seq" for SP/CP.
+A layout with ``fsdp`` set additionally shards the non-TP matmul dim
+(hidden; vocab for the embeddings) along the fsdp axis.  Batch dims of
+activations shard on "data"; sequence on "seq" for SP/CP.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_llm_rca_tpu.config import ModelConfig
+from k8s_llm_rca_tpu.runtime.rules import (  # noqa: F401  (re-exports)
+    FSDP_LAYOUT,
+    SpecLayout,
+    TP_LAYOUT,
+    encoder_param_template,
+    encoder_rules,
+    is_param_leaf,
+    kv_cache_cp_specs,
+    kv_cache_specs,
+    llama_param_template,
+    llama_rules,
+    match_partition_rules,
+    paged_pool_specs,
+    validate_layout,
+)
 
 PyTree = Any
 
 
-def llama_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
-    """PartitionSpec pytree matching models/llama.init_params structure."""
-    layer = {
-        "attn_norm": P(None),
-        "mlp_norm": P(None),
-        "wq": P(None, "model"),
-        "wk": P(None, "model"),
-        "wv": P(None, "model"),
-        "wo": P("model", None),
-    }
-    if cfg.n_experts > 0:
-        layer.update(
-            {
-                "router": P(None, None),
-                # stacked experts: [E, H, I] / [E, I, H]; experts over the
-                # expert axis, hidden over model — EP x TP composes.
-                "w_gate": P("expert", None, "model"),
-                "w_up": P("expert", None, "model"),
-                "w_down": P("expert", "model", None),
-            }
-        )
-    else:
-        layer.update(
-            {
-                "w_gate": P(None, "model"),
-                "w_up": P(None, "model"),
-                "w_down": P("model", None),
-            }
-        )
-    specs: Dict[str, Any] = {
-        "embedding": P(None, "model"),
-        "final_norm": P(None),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
-    }
-    if not cfg.tie_embeddings:
-        specs["lm_head"] = P(None, "model")  # [V, H], hidden sharded like embedding
-    return specs
+def llama_param_specs(cfg: ModelConfig,
+                      layout: Optional[SpecLayout] = None) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models/llama.init_params structure,
+    derived from ``rules.llama_rules`` (dense + MoE) under ``layout``."""
+    return match_partition_rules(
+        llama_rules(cfg, layout), llama_param_template(cfg), table="llama")
 
 
-def encoder_param_specs(cfg) -> Dict[str, Any]:
-    """PartitionSpec pytree matching models/encoder.init_params structure.
-
-    Same TP layout as the decoder: q/k/v column-parallel (heads sharded over
-    "model"), wo row-parallel, FFN hidden dim sharded.  Biases of sharded
-    columns shard on the same axis; LayerNorm params replicate.
-    """
-    layer = {
-        "wq": P(None, "model"), "bq": P("model"),
-        "wk": P(None, "model"), "bk": P("model"),
-        "wv": P(None, "model"), "bv": P("model"),
-        "wo": P("model", None), "bo": P(None),
-        "attn_ln_w": P(None), "attn_ln_b": P(None),
-        "w_in": P(None, "model"), "b_in": P("model"),
-        "w_out": P("model", None), "b_out": P(None),
-        "mlp_ln_w": P(None), "mlp_ln_b": P(None),
-    }
-    return {
-        "word_embedding": P(None, "model"),
-        "position_embedding": P(None, "model"),
-        "type_embedding": P(None, "model"),
-        "embed_ln_w": P(None),
-        "embed_ln_b": P(None),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
-    }
-
-
-def kv_cache_specs() -> Any:
-    """KV cache [L, B, S, n_kv*d] (merged kv axis, models/llama.KVCache):
-    batch on data, the merged kv-head*head_dim axis on model — splitting the
-    merged axis over "model" is identical to sharding the kv-head axis it
-    row-major-contains when the "model" axis size divides n_kv; larger
-    meshes split inside heads (still correct shapes, but collectives land
-    mid-head — size the mesh like wk/wv columns)."""
-    return P(None, "data", None, "model")
-
-
-def kv_cache_cp_specs(seq_axis: str = "seq", head_axis: str = None,
-                      data_axis: str = None) -> Any:
-    """Context-parallel KV cache layout: the SEQUENCE axis of k/v
-    [L, B, S, kv] shards over ``seq_axis`` so each device stores 1/P of a
-    long context's KV bytes.  Decode under this layout needs no custom
-    kernel: GSPMD partitions the attention reduction over S and inserts
-    the combine collectives (greedy-parity-tested in test_parallel.py).
-    Returns (kv_spec, scale_spec) — scales [L, B, S] shard likewise.
-
-    ``head_axis``/``data_axis``: the CP×TP composition — the merged kv
-    axis additionally shards over "model" (seq-major × head-minor) and
-    slots over "data", stacking the TP layout on the CP one."""
-    return (P(None, data_axis, seq_axis, head_axis),
-            P(None, data_axis, seq_axis))
+def encoder_param_specs(cfg,
+                        layout: Optional[SpecLayout] = None) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models/encoder.init_params structure,
+    derived from ``rules.encoder_rules`` under ``layout``."""
+    return match_partition_rules(
+        encoder_rules(cfg, layout), encoder_param_template(cfg),
+        table="encoder")
 
 
 def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
@@ -149,6 +98,15 @@ def shard_pytree(tree: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
     return jax.tree.map(
         _put, tree, specs,
         is_leaf=lambda x: x is None or isinstance(x, quant_types))
+
+
+def shard_with_rules(rules, tree: PyTree, mesh: Mesh, *,
+                     table: str = "") -> PyTree:
+    """Match ``rules`` against ``tree`` and device-put the result: the one
+    call checkpoint ingestion routes through — an unseen param name fails
+    with the matcher's named-param ValueError BEFORE any weight moves."""
+    return shard_pytree(tree, match_partition_rules(rules, tree, table=table),
+                        mesh)
 
 
 def constrain(x, mesh: Mesh, spec: P):

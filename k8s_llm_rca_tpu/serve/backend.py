@@ -423,9 +423,13 @@ class EngineBackend:
         A malformed entry or a torn/corrupt kv blob raises ValueError —
         the transfer is discarded whole and the caller retries from the
         still-pinned source; this backend is left untouched.  A kv
-        record that decodes but does not fit this engine (different
-        pool layout) is silently dropped by the engine's own adopt
-        validation — the run re-prefills, byte-identical output."""
+        record that decodes but was gathered under a different PAGE
+        SIZE is re-chunked deterministically by the engine's adopt
+        (``engine.handoff_kv_relayout``); one whose dtype/kv_dim/layer
+        geometry differs raises ValueError (a misconfigured tier pair —
+        TierRouter refuses to build one); torn frames (length mismatch,
+        page overflow) drop to a counted re-prefill, byte-identical
+        output."""
         entry = frame.get("seq") if isinstance(frame, dict) else None
         if (not isinstance(entry, dict)
                 or not {"seq_id", "prompt_ids", "generated",

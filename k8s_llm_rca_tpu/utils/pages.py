@@ -163,6 +163,56 @@ def records_compatible(pool, rec: Dict[str, object]) -> bool:
     return True
 
 
+def convert_page_record(rec: Dict[str, object], length: int,
+                        dst_page_size: int) -> Dict[str, object]:
+    """Re-chunk a multi-page record onto a different page size — the
+    deterministic half of the tier-handoff layout bridge
+    (engine/paged.py ``adopt_run``): a prefill tier running page_size=P
+    and a decode tier running page_size=Q hold the SAME ``length``
+    tokens of KV, just chunked differently, so the record converts by
+    flattening the (page, token) axes, truncating to the ``length``
+    valid tokens, zero-padding to the next Q multiple and re-chunking.
+    Tail padding is zeros — positions past ``length`` are never read
+    (the paged attention masks by sequence length), so the conversion
+    is byte-deterministic.
+
+    Raises ValueError (never silently drops KV) when ``length`` does
+    not fit the record or the arrays disagree with ``n_pages`` — a torn
+    frame must surface as the adopter's loud rejection, not as garbage
+    pages."""
+    src = np.asarray(rec["k"])
+    if src.ndim != 4:
+        raise ValueError(
+            f"convert_page_record: k has rank {src.ndim}, want "
+            f"[L, n_pages, page, kv]")
+    n_src, ps_src = int(rec["n_pages"]), int(src.shape[2])
+    if src.shape[1] != n_src:
+        raise ValueError(
+            f"convert_page_record: record claims {n_src} pages but k "
+            f"carries {src.shape[1]}")
+    if not (0 < length <= n_src * ps_src):
+        raise ValueError(
+            f"convert_page_record: length={length} does not fit "
+            f"{n_src} pages of {ps_src} tokens")
+    if dst_page_size <= 0:
+        raise ValueError(
+            f"convert_page_record: dst_page_size={dst_page_size}")
+    if dst_page_size == ps_src:
+        return rec
+    n_dst = -(-length // dst_page_size)       # ceil
+    padded = n_dst * dst_page_size
+    out: Dict[str, object] = {"n_pages": n_dst}
+    for f in record_fields(rec):
+        arr = np.asarray(rec[f])
+        L = arr.shape[0]
+        tail = arr.shape[3:]                  # (kv,) for k/v, () for scales
+        flat = arr.reshape((L, n_src * ps_src) + tail)[:, :length]
+        full = np.zeros((L, padded) + tail, dtype=arr.dtype)
+        full[:, :length] = flat
+        out[f] = full.reshape((L, n_dst, dst_page_size) + tail)
+    return out
+
+
 # --------------------------------------------------------------- disk codec
 
 def encode_page_record(rec: Dict[str, object]) -> bytes:
